@@ -1,0 +1,30 @@
+"""pbft_tpu — a TPU-native Practical Byzantine Fault Tolerance framework.
+
+Built from scratch with the capability surface of the reference
+``ameya-deshmukh/pbft`` (Rust + libp2p normal-case PBFT; see SURVEY.md):
+PRE-PREPARE -> PREPARE -> COMMIT with a JSON-over-TCP client front-end —
+re-designed TPU-first:
+
+- ``pbft_tpu.crypto``    — the hot path: batched Ed25519 signature verification
+  as a single ``jax.vmap``'d XLA launch (SHA-512 + GF(2^255-19) field kernels),
+  plus a pure-Python reference oracle.
+- ``pbft_tpu.consensus`` — the deterministic replica state machine with *real*
+  quorums (2f prepares, 2f+1 commits; the reference stubbed these to >= 1,
+  reference src/behavior.rs:181,:208,:222), logs keyed by (view, seq) for all
+  three phases (fixing reference src/state.rs:23), watermarks and the
+  exactly-once timestamp guard (reference src/behavior.rs:391-398).
+- ``pbft_tpu.parallel``  — sharding the verification batch over a
+  ``jax.sharding.Mesh`` (data-parallel over the signature axis, scaling to
+  multi-chip/multi-host via XLA collectives).
+- ``pbft_tpu.net``       — client gateway contract (JSON request in, dial-back
+  reply out; reference src/client_handler.rs) and the cluster launcher.
+
+JAX x64 is required for the uint64/int64 limb arithmetic used by the crypto
+kernels; importing this package enables it (before any jax usage).
+"""
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+__version__ = "0.1.0"

@@ -1,0 +1,11 @@
+"""Crypto subsystem: Ed25519 + digests.
+
+- ``ref``     — pure-Python (big-int) Ed25519: the correctness oracle, key
+  generation, and the signer used by clients/replicas on the host side.
+- ``sha512``  — JAX SHA-512 (uint64), fixed-shape, vmappable.
+- ``field``   — JAX GF(2^255-19) and mod-L limb arithmetic.
+- ``ed25519`` — JAX Ed25519 verification (decompress, Shamir double-scalar
+  ladder, compress) built on ``field`` + ``sha512``.
+- ``batch``   — the batched verifier: one XLA launch per (pubkey, msg, sig)
+  tensor, returning a per-item validity bitmap.
+"""

@@ -1,0 +1,16 @@
+"""Test configuration.
+
+Tests run on CPU with 8 virtual XLA devices so that the multi-chip sharding
+path (pbft_tpu.parallel) is exercised without TPU hardware, mirroring how the
+driver dry-runs `__graft_entry__.dryrun_multichip`. Must be set before jax
+initializes its backends.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

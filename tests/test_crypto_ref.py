@@ -1,0 +1,97 @@
+"""RFC 8032 known-answer tests + independent-library cross-checks for the
+pure-Python Ed25519 oracle (pbft_tpu.crypto.ref)."""
+
+import secrets
+
+import pytest
+
+from pbft_tpu.crypto import ref
+
+# RFC 8032 §7.1 test vectors: (secret seed, public key, message, signature).
+RFC8032_VECTORS = [
+    (
+        "9d61b19deffd5a60ba844af492ec2cc44449c5697b326919703bac031cae7f60",
+        "d75a980182b10ab7d54bfed3c964073a0ee172f3daa62325af021a68f707511a",
+        "",
+        "e5564300c360ac729086e2cc806e828a84877f1eb8e5d974d873e06522490155"
+        "5fb8821590a33bacc61e39701cf9b46bd25bf5f0595bbe24655141438e7a100b",
+    ),
+    (
+        "4ccd089b28ff96da9db6c346ec114e0f5b8a319f35aba624da8cf6ed4fb8a6fb",
+        "3d4017c3e843895a92b70aa74d1b7ebc9c982ccf2ec4968cc0cd55f12af4660c",
+        "72",
+        "92a009a9f0d4cab8720e820b5f642540a2b27b5416503f8fb3762223ebdb69da"
+        "085ac1e43e15996e458f3613d0f11d8c387b2eaeb4302aeeb00d291612bb0c00",
+    ),
+    (
+        "c5aa8df43f9f837bedb7442f31dcb7b166d38535076f094b85ce3a2e0b4458f7",
+        "fc51cd8e6218a1a38da47ed00230f0580816ed13ba3303ac5deb911548908025",
+        "af82",
+        "6291d657deec24024827e69c3abe01a30ce548a284743a445e3680d7db5ac3ac"
+        "18ff9b538d16f290ae67f760984dc6594a7c15e9716ed28dc027beceea1ec40a",
+    ),
+    (
+        "833fe62409237b9d62ec77587520911e9a759cec1d19755b7da901b96dca3d42",
+        "ec172b93ad5e563bf4932c70e1245034c35467ef2efd4d64ebf819683467e2bf",
+        "ddaf35a193617abacc417349ae20413112e6fa4e89a97ea20a9eeee64b55d39a"
+        "2192992a274fc1a836ba3c23a3feebbd454d4423643ce80e2a9ac94fa54ca49f",
+        "dc2a4459e7369633a52b1bf277839a00201009a3efbf3ecb69bea2186c26b589"
+        "09351fc9ac90b3ecfdfbc7c66431e0303dca179c138ac17ad9bef1177331a704",
+    ),
+]
+
+
+@pytest.mark.parametrize("seed,pub,msg,sig", RFC8032_VECTORS)
+def test_rfc8032_sign(seed, pub, msg, sig):
+    seed, pub, msg, sig = (bytes.fromhex(x) for x in (seed, pub, msg, sig))
+    assert ref.public_key(seed) == pub
+    assert ref.sign(seed, msg) == sig
+    assert ref.verify(pub, msg, sig)
+
+
+@pytest.mark.parametrize("seed,pub,msg,sig", RFC8032_VECTORS)
+def test_rfc8032_reject_corruption(seed, pub, msg, sig):
+    pub, msg, sig = (bytes.fromhex(x) for x in (pub, msg, sig))
+    bad_sig = bytes([sig[0] ^ 1]) + sig[1:]
+    assert not ref.verify(pub, msg, bad_sig)
+    bad_s = sig[:32] + bytes([sig[32] ^ 1]) + sig[33:]
+    assert not ref.verify(pub, msg, bad_s)
+    assert not ref.verify(pub, msg + b"x", sig)
+    bad_pub = bytes([pub[0] ^ 1]) + pub[1:]
+    assert not ref.verify(bad_pub, msg, sig)
+
+
+def test_reject_s_out_of_range():
+    seed, pub = ref.keygen(b"\x07" * 32)
+    msg = b"range check"
+    sig = ref.sign(seed, msg)
+    s = int.from_bytes(sig[32:], "little")
+    malleated = sig[:32] + int.to_bytes(s + ref.L, 32, "little")
+    assert not ref.verify(pub, msg, malleated)
+
+
+def test_keygen_roundtrip_random():
+    for _ in range(8):
+        seed, pub = ref.keygen()
+        msg = secrets.token_bytes(48)
+        sig = ref.sign(seed, msg)
+        assert ref.verify(pub, msg, sig)
+        assert not ref.verify(pub, msg[:-1], sig)
+
+
+def test_cross_check_against_cryptography():
+    """Independent oracle: pyca/cryptography (OpenSSL) must agree with us."""
+    crypto = pytest.importorskip("cryptography.hazmat.primitives.asymmetric.ed25519")
+    for i in range(8):
+        seed = secrets.token_bytes(32)
+        msg = secrets.token_bytes(32 + i)
+        their_key = crypto.Ed25519PrivateKey.from_private_bytes(seed)
+        from cryptography.hazmat.primitives import serialization
+
+        their_pub = their_key.public_key().public_bytes(
+            serialization.Encoding.Raw, serialization.PublicFormat.Raw
+        )
+        their_sig = their_key.sign(msg)
+        assert ref.public_key(seed) == their_pub
+        assert ref.sign(seed, msg) == their_sig
+        assert ref.verify(their_pub, msg, their_sig)
