@@ -1,0 +1,19 @@
+// Blake2b (RFC 7693) — message digests for the C++ replica core.
+// The reference used the Rust blake2 crate for its request digests
+// (reference src/message.rs:3,:209-212); this is our own implementation,
+// equivalence-tested against Python hashlib.blake2b via ctypes.
+#pragma once
+
+#include <cstddef>
+#include <cstdint>
+
+namespace pbft {
+
+// Unkeyed Blake2b with digest length 1..64 bytes.
+void blake2b(uint8_t* out, size_t outlen, const uint8_t* in, size_t inlen);
+
+inline void blake2b_256(uint8_t out[32], const uint8_t* in, size_t inlen) {
+  blake2b(out, 32, in, inlen);
+}
+
+}  // namespace pbft

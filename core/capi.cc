@@ -1,0 +1,70 @@
+// C ABI exports for ctypes (Python <-> C++ equivalence tests and the
+// Python-side use of the native CPU verifier). pybind11 is not available in
+// this environment; ctypes over a plain C ABI is the binding layer.
+#include <cstddef>
+#include <cstdint>
+#include <cstring>
+#include <string>
+
+#include "blake2b.h"
+#include "ed25519.h"
+#include "messages.h"
+#include "sha512.h"
+
+extern "C" {
+
+// Parse a JSON message payload, re-serialize canonically, and compute its
+// signable digest. Returns the canonical length (0 on parse failure).
+// Canonical bytes go to out_canonical (cap bytes), digest to out_digest[32].
+// Used by the Python tests to prove C++ and Python encodings are
+// byte-identical (SURVEY.md §7 "determinism at the FFI boundary").
+size_t pbft_message_roundtrip(const uint8_t* payload, size_t payload_len,
+                              uint8_t* out_canonical, size_t cap,
+                              uint8_t out_digest[32]) {
+  std::string text((const char*)payload, payload_len);
+  auto msg = pbft::from_payload(text);
+  if (!msg) return 0;
+  std::string canon = pbft::message_canonical(*msg);
+  if (canon.size() <= cap) {
+    std::memcpy(out_canonical, canon.data(), canon.size());
+  }
+  pbft::message_signable(*msg, out_digest);
+  return canon.size();
+}
+
+void pbft_blake2b(uint8_t* out, size_t outlen, const uint8_t* in,
+                  size_t inlen) {
+  pbft::blake2b(out, outlen, in, inlen);
+}
+
+void pbft_sha512(uint8_t out[64], const uint8_t* in, size_t inlen) {
+  pbft::sha512(out, in, inlen);
+}
+
+void pbft_ed25519_public_key(uint8_t pub[32], const uint8_t seed[32]) {
+  pbft::ed25519_public_key(pub, seed);
+}
+
+void pbft_ed25519_sign(uint8_t sig[64], const uint8_t seed[32],
+                       const uint8_t* msg, size_t msglen) {
+  pbft::ed25519_sign(sig, seed, msg, msglen);
+}
+
+int pbft_ed25519_verify(const uint8_t pub[32], const uint8_t* msg,
+                        size_t msglen, const uint8_t sig[64]) {
+  return pbft::ed25519_verify(pub, msg, msglen, sig) ? 1 : 0;
+}
+
+// Batch CPU verification (the control arm): items laid out as
+// pubs[32*i], msgs[32*i], sigs[64*i]; out[i] = 1 if valid.
+void pbft_ed25519_verify_batch(const uint8_t* pubs, const uint8_t* msgs,
+                               const uint8_t* sigs, uint8_t* out, size_t n) {
+  for (size_t i = 0; i < n; ++i) {
+    out[i] = pbft::ed25519_verify(pubs + 32 * i, msgs + 32 * i, 32,
+                                  sigs + 64 * i)
+                 ? 1
+                 : 0;
+  }
+}
+
+}  // extern "C"

@@ -1,0 +1,445 @@
+#include "ed25519.h"
+
+#include <cstring>
+
+#include "sha512.h"
+
+namespace pbft {
+namespace {
+
+using u64 = uint64_t;
+using u128 = unsigned __int128;
+
+// ---------------------------------------------------------------------------
+// GF(2^255-19), radix 2^51, limbs kept < ~2^52 between ops.
+// ---------------------------------------------------------------------------
+
+struct fe {
+  u64 v[5];
+};
+
+#include "ed25519_consts.inc"
+
+constexpr u64 kMask51 = (1ULL << 51) - 1;
+constexpr fe kFeOne = {1, 0, 0, 0, 0};
+constexpr fe kFeZero = {0, 0, 0, 0, 0};
+// 4p limbwise (added before subtraction so limbs never underflow):
+// 4*(2^51-19) and 4*(2^51-1).
+constexpr u64 k4P0 = 0x1FFFFFFFFFFFB4ULL;
+constexpr u64 k4P1234 = 0x1FFFFFFFFFFFFCULL;
+
+fe fe_add(const fe& a, const fe& b) {
+  fe r;
+  for (int i = 0; i < 5; ++i) r.v[i] = a.v[i] + b.v[i];
+  return r;
+}
+
+fe fe_sub(const fe& a, const fe& b) {
+  fe r;
+  r.v[0] = a.v[0] + k4P0 - b.v[0];
+  r.v[1] = a.v[1] + k4P1234 - b.v[1];
+  r.v[2] = a.v[2] + k4P1234 - b.v[2];
+  r.v[3] = a.v[3] + k4P1234 - b.v[3];
+  r.v[4] = a.v[4] + k4P1234 - b.v[4];
+  return r;
+}
+
+fe fe_carry(const fe& a) {
+  fe r = a;
+  u64 c;
+  c = r.v[0] >> 51; r.v[0] &= kMask51; r.v[1] += c;
+  c = r.v[1] >> 51; r.v[1] &= kMask51; r.v[2] += c;
+  c = r.v[2] >> 51; r.v[2] &= kMask51; r.v[3] += c;
+  c = r.v[3] >> 51; r.v[3] &= kMask51; r.v[4] += c;
+  c = r.v[4] >> 51; r.v[4] &= kMask51; r.v[0] += 19 * c;
+  c = r.v[0] >> 51; r.v[0] &= kMask51; r.v[1] += c;
+  return r;
+}
+
+fe fe_mul(const fe& a, const fe& b) {
+  u128 t0 = (u128)a.v[0] * b.v[0] +
+            (u128)(19 * a.v[1]) * b.v[4] + (u128)(19 * a.v[2]) * b.v[3] +
+            (u128)(19 * a.v[3]) * b.v[2] + (u128)(19 * a.v[4]) * b.v[1];
+  u128 t1 = (u128)a.v[0] * b.v[1] + (u128)a.v[1] * b.v[0] +
+            (u128)(19 * a.v[2]) * b.v[4] + (u128)(19 * a.v[3]) * b.v[3] +
+            (u128)(19 * a.v[4]) * b.v[2];
+  u128 t2 = (u128)a.v[0] * b.v[2] + (u128)a.v[1] * b.v[1] +
+            (u128)a.v[2] * b.v[0] + (u128)(19 * a.v[3]) * b.v[4] +
+            (u128)(19 * a.v[4]) * b.v[3];
+  u128 t3 = (u128)a.v[0] * b.v[3] + (u128)a.v[1] * b.v[2] +
+            (u128)a.v[2] * b.v[1] + (u128)a.v[3] * b.v[0] +
+            (u128)(19 * a.v[4]) * b.v[4];
+  u128 t4 = (u128)a.v[0] * b.v[4] + (u128)a.v[1] * b.v[3] +
+            (u128)a.v[2] * b.v[2] + (u128)a.v[3] * b.v[1] +
+            (u128)a.v[4] * b.v[0];
+  fe r;
+  u128 c;
+  c = t0 >> 51; r.v[0] = (u64)t0 & kMask51; t1 += c;
+  c = t1 >> 51; r.v[1] = (u64)t1 & kMask51; t2 += c;
+  c = t2 >> 51; r.v[2] = (u64)t2 & kMask51; t3 += c;
+  c = t3 >> 51; r.v[3] = (u64)t3 & kMask51; t4 += c;
+  c = t4 >> 51; r.v[4] = (u64)t4 & kMask51;
+  r.v[0] += 19 * (u64)c;
+  u64 c2 = r.v[0] >> 51; r.v[0] &= kMask51; r.v[1] += c2;
+  return r;
+}
+
+fe fe_sq(const fe& a) { return fe_mul(a, a); }
+
+fe fe_pow2k(fe z, int k) {
+  while (k-- > 0) z = fe_sq(z);
+  return z;
+}
+
+// Shared exponent chain (see pbft_tpu/crypto/field.py:_inv_chain).
+void fe_chain250(const fe& z, fe* z_250_0, fe* z11) {
+  fe z2 = fe_sq(z);
+  fe z8 = fe_pow2k(z2, 2);
+  fe z9 = fe_mul(z, z8);
+  *z11 = fe_mul(z2, z9);
+  fe z22 = fe_sq(*z11);
+  fe z_5_0 = fe_mul(z9, z22);
+  fe z_10_0 = fe_mul(fe_pow2k(z_5_0, 5), z_5_0);
+  fe z_20_0 = fe_mul(fe_pow2k(z_10_0, 10), z_10_0);
+  fe z_40_0 = fe_mul(fe_pow2k(z_20_0, 20), z_20_0);
+  fe z_50_0 = fe_mul(fe_pow2k(z_40_0, 10), z_10_0);
+  fe z_100_0 = fe_mul(fe_pow2k(z_50_0, 50), z_50_0);
+  fe z_200_0 = fe_mul(fe_pow2k(z_100_0, 100), z_100_0);
+  *z_250_0 = fe_mul(fe_pow2k(z_200_0, 50), z_50_0);
+}
+
+fe fe_invert(const fe& z) {  // z^(p-2) = z^(2^255 - 21)
+  fe z_250_0, z11;
+  fe_chain250(z, &z_250_0, &z11);
+  return fe_mul(fe_pow2k(z_250_0, 5), z11);
+}
+
+fe fe_pow22523(const fe& z) {  // z^((p-5)/8) = z^(2^252 - 3)
+  fe z_250_0, z11;
+  fe_chain250(z, &z_250_0, &z11);
+  return fe_mul(fe_pow2k(z_250_0, 2), z);
+}
+
+fe fe_canon(const fe& a) {
+  fe r = fe_carry(fe_carry(a));
+  // Conditionally subtract p (possibly twice; r < 2^255+eps after carries).
+  // p limbs = (2^51-19, 2^51-1, 2^51-1, 2^51-1, 2^51-1).
+  for (int pass = 0; pass < 2; ++pass) {
+    u64 t0 = r.v[0] - (kMask51 - 18);
+    u64 b = t0 >> 63;
+    u64 t1 = r.v[1] - kMask51 - b;  b = t1 >> 63;
+    u64 t2 = r.v[2] - kMask51 - b;  b = t2 >> 63;
+    u64 t3 = r.v[3] - kMask51 - b;  b = t3 >> 63;
+    u64 t4 = r.v[4] - kMask51 - b;  b = t4 >> 63;
+    if (!b) {
+      r.v[0] = t0 & kMask51; r.v[1] = t1 & kMask51; r.v[2] = t2 & kMask51;
+      r.v[3] = t3 & kMask51; r.v[4] = t4 & kMask51;
+    }
+  }
+  return r;
+}
+
+bool fe_eq(const fe& a, const fe& b) {
+  fe x = fe_canon(a), y = fe_canon(b);
+  u64 diff = 0;
+  for (int i = 0; i < 5; ++i) diff |= x.v[i] ^ y.v[i];
+  return diff == 0;
+}
+
+bool fe_is_zero(const fe& a) { return fe_eq(a, kFeZero); }
+
+fe fe_neg(const fe& a) { return fe_carry(fe_sub(kFeZero, a)); }
+
+fe fe_frombytes(const uint8_t s[32]) {
+  auto load = [&](int off) {
+    u64 v = 0;
+    for (int i = 7; i >= 0; --i) v = (v << 8) | s[off + i];
+    return v;
+  };
+  fe r;
+  r.v[0] = load(0) & kMask51;
+  r.v[1] = (load(6) >> 3) & kMask51;
+  r.v[2] = (load(12) >> 6) & kMask51;
+  r.v[3] = (load(19) >> 1) & kMask51;
+  r.v[4] = (load(24) >> 12) & kMask51;
+  return r;
+}
+
+void fe_tobytes(uint8_t s[32], const fe& a) {
+  fe r = fe_canon(a);
+  std::memset(s, 0, 32);
+  // Pack 5x51 bits little-endian.
+  u64 parts[5] = {r.v[0], r.v[1], r.v[2], r.v[3], r.v[4]};
+  int bit = 0;
+  for (int i = 0; i < 5; ++i) {
+    for (int j = 0; j < 51; ++j) {
+      if ((parts[i] >> j) & 1) s[(bit + j) / 8] |= 1u << ((bit + j) % 8);
+    }
+    bit += 51;
+  }
+}
+
+bool fe_is_canonical_bytes(const uint8_t s[32]) {
+  // y < p, with s[31]'s sign bit already masked by the caller.
+  // p = 2^255 - 19: reject iff all bits 1 in [2^5..2^255) region pattern:
+  u64 lo;
+  std::memcpy(&lo, s, 8);
+  if (lo < 0xFFFFFFFFFFFFFFEDULL) return true;
+  for (int i = 8; i < 32; ++i) {
+    uint8_t want = (i == 31) ? 0x7F : 0xFF;
+    if (s[i] != want) return true;
+  }
+  return false;  // s >= p
+}
+
+// ---------------------------------------------------------------------------
+// Group: extended coordinates (X:Y:Z:T), a = -1 twisted Edwards.
+// ---------------------------------------------------------------------------
+
+struct ge {
+  fe x, y, z, t;
+};
+
+const ge kGeIdentity = {kFeZero, kFeOne, kFeOne, kFeZero};
+const ge kGeBase = {kConst_bx, kConst_by, kFeOne, kConst_bt};
+
+ge ge_add(const ge& p, const ge& q) {
+  fe a = fe_mul(fe_carry(fe_sub(p.y, p.x)), fe_carry(fe_sub(q.y, q.x)));
+  fe b = fe_mul(fe_carry(fe_add(p.y, p.x)), fe_carry(fe_add(q.y, q.x)));
+  fe c = fe_mul(fe_mul(p.t, kConst_d2), q.t);
+  fe zz = fe_mul(p.z, q.z);
+  fe d = fe_carry(fe_add(zz, zz));
+  fe e = fe_carry(fe_sub(b, a));
+  fe f = fe_carry(fe_sub(d, c));
+  fe g = fe_carry(fe_add(d, c));
+  fe h = fe_carry(fe_add(b, a));
+  return {fe_mul(e, f), fe_mul(g, h), fe_mul(f, g), fe_mul(e, h)};
+}
+
+ge ge_neg(const ge& p) { return {fe_neg(p.x), p.y, p.z, fe_neg(p.t)}; }
+
+bool ge_decompress(ge* out, const uint8_t bytes[32]) {
+  uint8_t s[32];
+  std::memcpy(s, bytes, 32);
+  int sign = s[31] >> 7;
+  s[31] &= 0x7F;
+  if (!fe_is_canonical_bytes(s)) return false;
+  fe y = fe_frombytes(s);
+  fe y2 = fe_sq(y);
+  fe u = fe_carry(fe_sub(y2, kFeOne));
+  fe v = fe_carry(fe_add(fe_mul(y2, kConst_d), kFeOne));
+  // x = u v^3 (u v^7)^((p-5)/8), corrected by sqrt(-1) when needed.
+  fe v3 = fe_mul(v, fe_sq(v));
+  fe v7 = fe_mul(v3, fe_sq(fe_sq(v)));
+  fe x = fe_mul(fe_mul(u, v3), fe_pow22523(fe_mul(u, v7)));
+  fe check = fe_mul(v, fe_sq(x));
+  if (!fe_eq(check, u)) {
+    if (fe_eq(check, fe_neg(u))) {
+      x = fe_mul(x, kConst_sqrtm1);
+    } else {
+      return false;
+    }
+  }
+  x = fe_canon(x);
+  bool x_zero = fe_is_zero(x);
+  if (x_zero && sign) return false;
+  if ((int)(x.v[0] & 1) != sign) x = fe_neg(x);
+  out->x = x;
+  out->y = y;
+  out->z = kFeOne;
+  out->t = fe_mul(x, y);
+  return true;
+}
+
+void ge_compress(uint8_t s[32], const ge& p) {
+  fe zi = fe_invert(p.z);
+  fe x = fe_canon(fe_mul(p.x, zi));
+  fe y = fe_mul(p.y, zi);
+  fe_tobytes(s, y);
+  s[31] |= (uint8_t)((x.v[0] & 1) << 7);
+}
+
+// ---------------------------------------------------------------------------
+// Scalars mod L = 2^252 + delta.
+// ---------------------------------------------------------------------------
+
+constexpr u64 kL[4] = {0x5812631a5cf5d3edULL, 0x14def9dea2f79cd6ULL, 0ULL,
+                       0x1000000000000000ULL};
+
+// x -= L << bitshift when that keeps x >= 0 (x: n 64-bit LE limbs).
+// Returns whether the subtraction happened.
+bool sub_l_shifted_if_ge(u64* x, int n, int bitshift) {
+  u64 tmp[12];
+  std::memcpy(tmp, x, n * 8);
+  int limb = bitshift / 64, off = bitshift % 64;
+  u128 borrow = 0;
+  for (int i = 0; i < n; ++i) {
+    u128 sub = borrow;
+    int j = i - limb;
+    u64 part = 0;
+    if (j >= 0 && j < 4) part = kL[j] << off;
+    if (off && j - 1 >= 0 && j - 1 < 4) part |= kL[j - 1] >> (64 - off);
+    sub += part;
+    u128 cur = (u128)tmp[i];
+    if (cur >= sub) {
+      tmp[i] = (u64)(cur - sub);
+      borrow = 0;
+    } else {
+      tmp[i] = (u64)(cur + (((u128)1) << 64) - sub);
+      borrow = 1;
+    }
+  }
+  if (borrow) return false;
+  std::memcpy(x, tmp, n * 8);
+  return true;
+}
+
+// 512-bit (8 limb) value -> 256-bit scalar mod L (4 limbs). Binary long
+// division: L's top bit is 2^252, input < 2^512, so shifts 259..0 suffice.
+void sc_reduce512(u64 out[4], const u64 in[8]) {
+  u64 x[12];
+  std::memcpy(x, in, 64);
+  std::memset(x + 8, 0, 32);
+  for (int shift = 259; shift >= 0; --shift) {
+    sub_l_shifted_if_ge(x, 12, shift);
+  }
+  std::memcpy(out, x, 32);
+}
+
+bool sc_lt_l(const u64 s[4]) {
+  for (int i = 3; i >= 0; --i) {
+    if (s[i] < kL[i]) return true;
+    if (s[i] > kL[i]) return false;
+  }
+  return false;
+}
+
+void sc_from_bytes(u64 out[4], const uint8_t b[32]) {
+  std::memcpy(out, b, 32);  // little-endian host
+}
+
+void sc_to_bytes(uint8_t out[32], const u64 s[4]) { std::memcpy(out, s, 32); }
+
+// (a*b + c) mod L for signing.
+void sc_muladd(u64 out[4], const u64 a[4], const u64 b[4], const u64 c[4]) {
+  u64 wide[8] = {0};
+  for (int i = 0; i < 4; ++i) {
+    u128 carry = 0;
+    for (int j = 0; j < 4; ++j) {
+      u128 cur = (u128)wide[i + j] + (u128)a[i] * b[j] + carry;
+      wide[i + j] = (u64)cur;
+      carry = cur >> 64;
+    }
+    wide[i + 4] += (u64)carry;
+  }
+  u128 carry = 0;
+  for (int i = 0; i < 4; ++i) {
+    u128 cur = (u128)wide[i] + c[i] + carry;
+    wide[i] = (u64)cur;
+    carry = cur >> 64;
+  }
+  for (int i = 4; i < 8 && carry; ++i) {
+    u128 cur = (u128)wide[i] + carry;
+    wide[i] = (u64)cur;
+    carry = cur >> 64;
+  }
+  sc_reduce512(out, wide);
+}
+
+// ---------------------------------------------------------------------------
+// High level.
+// ---------------------------------------------------------------------------
+
+// acc = [s1]B + [s2]Q, Shamir/Straus with per-bit table {O, B, Q, B+Q}.
+ge double_scalar_mult(const u64 s1[4], const ge& q, const u64 s2[4]) {
+  ge table[4] = {kGeIdentity, kGeBase, q, ge_add(kGeBase, q)};
+  ge acc = kGeIdentity;
+  for (int bit = 255; bit >= 0; --bit) {
+    acc = ge_add(acc, acc);
+    int b1 = (s1[bit / 64] >> (bit % 64)) & 1;
+    int b2 = (s2[bit / 64] >> (bit % 64)) & 1;
+    int idx = b1 | (b2 << 1);
+    if (idx) acc = ge_add(acc, table[idx]);
+  }
+  return acc;
+}
+
+void expand_seed(u64 a_sc[4], uint8_t prefix[32], const uint8_t seed[32]) {
+  uint8_t h[64];
+  sha512(h, seed, 32);
+  h[0] &= 248;
+  h[31] &= 127;
+  h[31] |= 64;
+  sc_from_bytes(a_sc, h);
+  std::memcpy(prefix, h + 32, 32);
+}
+
+void hash_to_scalar(u64 out[4], const uint8_t* p1, const uint8_t* p2,
+                    const uint8_t* p3, size_t n3) {
+  // SHA512(p1 || p2 || p3) mod L, p1/p2 32 bytes each (or p2 null).
+  uint8_t buf[64 + 4096];
+  size_t off = 0;
+  std::memcpy(buf + off, p1, 32); off += 32;
+  if (p2) { std::memcpy(buf + off, p2, 32); off += 32; }
+  // long messages hashed in streaming fashion would be better; PBFT signs
+  // 32-byte digests so n3 is tiny.
+  std::memcpy(buf + off, p3, n3); off += n3;
+  uint8_t h[64];
+  sha512(h, buf, off);
+  u64 wide[8];
+  std::memcpy(wide, h, 64);
+  sc_reduce512(out, wide);
+}
+
+}  // namespace
+
+void ed25519_public_key(uint8_t pub[32], const uint8_t seed[32]) {
+  u64 a[4];
+  uint8_t prefix[32];
+  expand_seed(a, prefix, seed);
+  u64 zero[4] = {0, 0, 0, 0};
+  ge p = double_scalar_mult(a, kGeIdentity, zero);
+  ge_compress(pub, p);
+}
+
+void ed25519_sign(uint8_t sig[64], const uint8_t seed[32], const uint8_t* msg,
+                  size_t msglen) {
+  u64 a[4];
+  uint8_t prefix[32];
+  expand_seed(a, prefix, seed);
+  uint8_t pub[32];
+  {
+    u64 zero[4] = {0, 0, 0, 0};
+    ge p = double_scalar_mult(a, kGeIdentity, zero);
+    ge_compress(pub, p);
+  }
+  u64 r[4];
+  hash_to_scalar(r, prefix, nullptr, msg, msglen);
+  u64 zero[4] = {0, 0, 0, 0};
+  ge rp = double_scalar_mult(r, kGeIdentity, zero);
+  uint8_t rbytes[32];
+  ge_compress(rbytes, rp);
+  u64 h[4];
+  hash_to_scalar(h, rbytes, pub, msg, msglen);
+  u64 s[4];
+  sc_muladd(s, h, a, r);
+  std::memcpy(sig, rbytes, 32);
+  sc_to_bytes(sig + 32, s);
+}
+
+bool ed25519_verify(const uint8_t pub[32], const uint8_t* msg, size_t msglen,
+                    const uint8_t sig[64]) {
+  ge a;
+  if (!ge_decompress(&a, pub)) return false;
+  u64 s[4];
+  sc_from_bytes(s, sig + 32);
+  if (!sc_lt_l(s)) return false;
+  u64 h[4];
+  hash_to_scalar(h, sig, pub, msg, msglen);
+  ge p = double_scalar_mult(s, ge_neg(a), h);  // [S]B + [h](-A)
+  uint8_t enc[32];
+  ge_compress(enc, p);
+  return std::memcmp(enc, sig, 32) == 0;
+}
+
+}  // namespace pbft

@@ -1,0 +1,31 @@
+// Ed25519 (RFC 8032) for the C++ replica core: the *CPU verifier backend*
+// (the control arm of the CPU-vs-TPU A/B, BASELINE.md config 2) and the
+// host-side signer used by pbftd.
+//
+// Our own implementation: GF(2^255-19) in 5x51-bit limbs with unsigned
+// __int128 accumulation, complete twisted-Edwards addition (a=-1), Shamir
+// double-scalar verification — the same verification equation and accept set
+// as pbft_tpu.crypto.ref / pbft_tpu.crypto.ed25519 (cofactorless, strict
+// S < L, canonical-A rejection). Equivalence-tested against both via ctypes.
+//
+// The reference generated an Ed25519 keypair but never signed or verified
+// (reference src/main.rs:39, TODOs at src/behavior.rs:127,:185).
+#pragma once
+
+#include <cstddef>
+#include <cstdint>
+
+namespace pbft {
+
+// Public key (32B) from a 32-byte seed.
+void ed25519_public_key(uint8_t pub[32], const uint8_t seed[32]);
+
+// Detached signature (64B = R||S) over msg.
+void ed25519_sign(uint8_t sig[64], const uint8_t seed[32], const uint8_t* msg,
+                  size_t msglen);
+
+// Cofactorless RFC 8032 verify; strict S < L; rejects non-canonical A.
+bool ed25519_verify(const uint8_t pub[32], const uint8_t* msg, size_t msglen,
+                    const uint8_t sig[64]);
+
+}  // namespace pbft

@@ -1,0 +1,300 @@
+#include "json.h"
+
+#include <cstdio>
+#include <cstring>
+
+namespace pbft {
+namespace {
+
+// -- serialization ----------------------------------------------------------
+
+void escape_string(const std::string& s, std::string* out) {
+  out->push_back('"');
+  size_t i = 0;
+  const size_t n = s.size();
+  auto emit_u16 = [&](unsigned cp) {
+    char buf[8];
+    std::snprintf(buf, sizeof(buf), "\\u%04x", cp & 0xFFFF);
+    out->append(buf, 6);
+  };
+  while (i < n) {
+    unsigned char c = s[i];
+    if (c == '"') {
+      out->append("\\\"");
+      ++i;
+    } else if (c == '\\') {
+      out->append("\\\\");
+      ++i;
+    } else if (c >= 0x20 && c <= 0x7E) {
+      out->push_back((char)c);
+      ++i;
+    } else if (c == '\n') {
+      out->append("\\n"); ++i;
+    } else if (c == '\t') {
+      out->append("\\t"); ++i;
+    } else if (c == '\r') {
+      out->append("\\r"); ++i;
+    } else if (c == '\b') {
+      out->append("\\b"); ++i;
+    } else if (c == '\f') {
+      out->append("\\f"); ++i;
+    } else if (c < 0x20) {
+      emit_u16(c);
+      ++i;
+    } else {
+      // Decode one UTF-8 sequence -> codepoint, emit \uXXXX (+ surrogate
+      // pair beyond the BMP), matching CPython's ensure_ascii path.
+      unsigned cp = 0;
+      int len = 1;
+      if ((c & 0xE0) == 0xC0) { cp = c & 0x1F; len = 2; }
+      else if ((c & 0xF0) == 0xE0) { cp = c & 0x0F; len = 3; }
+      else if ((c & 0xF8) == 0xF0) { cp = c & 0x07; len = 4; }
+      else { cp = 0xFFFD; len = 1; }
+      if (i + len > n) { cp = 0xFFFD; len = 1; }
+      for (int k = 1; k < len; ++k) cp = (cp << 6) | (s[i + k] & 0x3F);
+      if (cp >= 0x10000) {
+        cp -= 0x10000;
+        emit_u16(0xD800 + (cp >> 10));
+        emit_u16(0xDC00 + (cp & 0x3FF));
+      } else {
+        emit_u16(cp);
+      }
+      i += len;
+    }
+  }
+  out->push_back('"');
+}
+
+// -- parsing ----------------------------------------------------------------
+
+struct Parser {
+  const char* p;
+  const char* end;
+
+  void skip_ws() {
+    while (p < end && (*p == ' ' || *p == '\t' || *p == '\n' || *p == '\r'))
+      ++p;
+  }
+
+  bool parse_value(Json* out) {
+    skip_ws();
+    if (p >= end) return false;
+    switch (*p) {
+      case '{': return parse_object(out);
+      case '[': return parse_array(out);
+      case '"': return parse_string_value(out);
+      case 't':
+        if (end - p >= 4 && !std::strncmp(p, "true", 4)) {
+          p += 4; *out = Json(true); return true;
+        }
+        return false;
+      case 'f':
+        if (end - p >= 5 && !std::strncmp(p, "false", 5)) {
+          p += 5; *out = Json(false); return true;
+        }
+        return false;
+      case 'n':
+        if (end - p >= 4 && !std::strncmp(p, "null", 4)) {
+          p += 4; *out = Json(); return true;
+        }
+        return false;
+      default: return parse_number(out);
+    }
+  }
+
+  bool parse_object(Json* out) {
+    ++p;  // '{'
+    JsonObject obj;
+    skip_ws();
+    if (p < end && *p == '}') { ++p; *out = Json(std::move(obj)); return true; }
+    while (true) {
+      skip_ws();
+      std::string key;
+      if (p >= end || *p != '"' || !parse_string_raw(&key)) return false;
+      skip_ws();
+      if (p >= end || *p != ':') return false;
+      ++p;
+      Json val;
+      if (!parse_value(&val)) return false;
+      obj.emplace(std::move(key), std::move(val));
+      skip_ws();
+      if (p < end && *p == ',') { ++p; continue; }
+      if (p < end && *p == '}') { ++p; *out = Json(std::move(obj)); return true; }
+      return false;
+    }
+  }
+
+  bool parse_array(Json* out) {
+    ++p;  // '['
+    JsonArray arr;
+    skip_ws();
+    if (p < end && *p == ']') { ++p; *out = Json(std::move(arr)); return true; }
+    while (true) {
+      Json val;
+      if (!parse_value(&val)) return false;
+      arr.push_back(std::move(val));
+      skip_ws();
+      if (p < end && *p == ',') { ++p; continue; }
+      if (p < end && *p == ']') { ++p; *out = Json(std::move(arr)); return true; }
+      return false;
+    }
+  }
+
+  void append_utf8(std::string* s, unsigned cp) {
+    if (cp < 0x80) {
+      s->push_back((char)cp);
+    } else if (cp < 0x800) {
+      s->push_back((char)(0xC0 | (cp >> 6)));
+      s->push_back((char)(0x80 | (cp & 0x3F)));
+    } else if (cp < 0x10000) {
+      s->push_back((char)(0xE0 | (cp >> 12)));
+      s->push_back((char)(0x80 | ((cp >> 6) & 0x3F)));
+      s->push_back((char)(0x80 | (cp & 0x3F)));
+    } else {
+      s->push_back((char)(0xF0 | (cp >> 18)));
+      s->push_back((char)(0x80 | ((cp >> 12) & 0x3F)));
+      s->push_back((char)(0x80 | ((cp >> 6) & 0x3F)));
+      s->push_back((char)(0x80 | (cp & 0x3F)));
+    }
+  }
+
+  bool parse_hex4(unsigned* out) {
+    if (end - p < 4) return false;
+    unsigned v = 0;
+    for (int i = 0; i < 4; ++i) {
+      char c = p[i];
+      v <<= 4;
+      if (c >= '0' && c <= '9') v |= c - '0';
+      else if (c >= 'a' && c <= 'f') v |= c - 'a' + 10;
+      else if (c >= 'A' && c <= 'F') v |= c - 'A' + 10;
+      else return false;
+    }
+    p += 4;
+    *out = v;
+    return true;
+  }
+
+  bool parse_string_raw(std::string* out) {
+    ++p;  // '"'
+    while (p < end) {
+      unsigned char c = *p;
+      if (c == '"') { ++p; return true; }
+      if (c == '\\') {
+        ++p;
+        if (p >= end) return false;
+        char e = *p++;
+        switch (e) {
+          case '"': out->push_back('"'); break;
+          case '\\': out->push_back('\\'); break;
+          case '/': out->push_back('/'); break;
+          case 'n': out->push_back('\n'); break;
+          case 't': out->push_back('\t'); break;
+          case 'r': out->push_back('\r'); break;
+          case 'b': out->push_back('\b'); break;
+          case 'f': out->push_back('\f'); break;
+          case 'u': {
+            unsigned cp;
+            if (!parse_hex4(&cp)) return false;
+            if (cp >= 0xD800 && cp < 0xDC00 && end - p >= 6 && p[0] == '\\' &&
+                p[1] == 'u') {
+              p += 2;
+              unsigned lo;
+              if (!parse_hex4(&lo)) return false;
+              cp = 0x10000 + ((cp - 0xD800) << 10) + (lo - 0xDC00);
+            }
+            append_utf8(out, cp);
+            break;
+          }
+          default: return false;
+        }
+      } else {
+        out->push_back((char)c);
+        ++p;
+      }
+    }
+    return false;
+  }
+
+  bool parse_string_value(Json* out) {
+    std::string s;
+    if (!parse_string_raw(&s)) return false;
+    *out = Json(std::move(s));
+    return true;
+  }
+
+  bool parse_number(Json* out) {
+    const char* start = p;
+    if (p < end && (*p == '-' || *p == '+')) ++p;
+    bool is_double = false;
+    while (p < end && ((*p >= '0' && *p <= '9') || *p == '.' || *p == 'e' ||
+                       *p == 'E' || *p == '-' || *p == '+')) {
+      if (*p == '.' || *p == 'e' || *p == 'E') is_double = true;
+      ++p;
+    }
+    if (p == start) return false;
+    std::string tok(start, p - start);
+    if (is_double) {
+      *out = Json(std::strtod(tok.c_str(), nullptr));
+    } else {
+      *out = Json((int64_t)std::strtoll(tok.c_str(), nullptr, 10));
+    }
+    return true;
+  }
+};
+
+}  // namespace
+
+std::string Json::dump() const {
+  std::string out;
+  switch (type_) {
+    case Type::Null: out = "null"; break;
+    case Type::Bool: out = int_ ? "true" : "false"; break;
+    case Type::Int: {
+      char buf[32];
+      std::snprintf(buf, sizeof(buf), "%lld", (long long)int_);
+      out = buf;
+      break;
+    }
+    case Type::Double: {
+      char buf[32];
+      std::snprintf(buf, sizeof(buf), "%g", dbl_);
+      out = buf;
+      break;
+    }
+    case Type::String: escape_string(str_, &out); break;
+    case Type::Object: {
+      out.push_back('{');
+      bool first = true;
+      for (const auto& [k, v] : obj_) {  // std::map iterates sorted
+        if (!first) out.push_back(',');
+        first = false;
+        escape_string(k, &out);
+        out.push_back(':');
+        out += v.dump();
+      }
+      out.push_back('}');
+      break;
+    }
+    case Type::Array: {
+      out.push_back('[');
+      for (size_t i = 0; i < arr_.size(); ++i) {
+        if (i) out.push_back(',');
+        out += arr_[i].dump();
+      }
+      out.push_back(']');
+      break;
+    }
+  }
+  return out;
+}
+
+std::optional<Json> Json::parse(const std::string& text) {
+  Parser parser{text.data(), text.data() + text.size()};
+  Json out;
+  if (!parser.parse_value(&out)) return std::nullopt;
+  parser.skip_ws();
+  if (parser.p != parser.end) return std::nullopt;
+  return out;
+}
+
+}  // namespace pbft

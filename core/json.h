@@ -1,0 +1,72 @@
+// Minimal JSON DOM for the C++ replica core.
+//
+// Serialization is *canonical* and byte-identical to Python's
+// json.dumps(obj, sort_keys=True, separators=(",", ":")) with the default
+// ensure_ascii=True — message digests and signatures are computed over these
+// bytes on both sides of the FFI boundary, so the encodings must agree
+// exactly (SURVEY.md §7 "determinism at the FFI boundary").
+#pragma once
+
+#include <cstdint>
+#include <map>
+#include <memory>
+#include <optional>
+#include <string>
+#include <vector>
+
+namespace pbft {
+
+class Json;
+using JsonObject = std::map<std::string, Json>;  // std::map sorts keys
+using JsonArray = std::vector<Json>;
+
+class Json {
+ public:
+  enum class Type { Null, Bool, Int, Double, String, Object, Array };
+
+  Json() : type_(Type::Null) {}
+  Json(bool b) : type_(Type::Bool), int_(b) {}
+  Json(int64_t v) : type_(Type::Int), int_(v) {}
+  Json(int v) : type_(Type::Int), int_(v) {}
+  Json(double v) : type_(Type::Double), dbl_(v) {}
+  Json(std::string s) : type_(Type::String), str_(std::move(s)) {}
+  Json(const char* s) : type_(Type::String), str_(s) {}
+  Json(JsonObject o) : type_(Type::Object), obj_(std::move(o)) {}
+  Json(JsonArray a) : type_(Type::Array), arr_(std::move(a)) {}
+
+  Type type() const { return type_; }
+  bool is_object() const { return type_ == Type::Object; }
+  bool is_array() const { return type_ == Type::Array; }
+  bool is_string() const { return type_ == Type::String; }
+  bool is_int() const { return type_ == Type::Int; }
+
+  int64_t as_int() const { return type_ == Type::Double ? (int64_t)dbl_ : int_; }
+  bool as_bool() const { return int_ != 0; }
+  double as_double() const { return type_ == Type::Int ? (double)int_ : dbl_; }
+  const std::string& as_string() const { return str_; }
+  const JsonObject& as_object() const { return obj_; }
+  JsonObject& as_object() { return obj_; }
+  const JsonArray& as_array() const { return arr_; }
+
+  const Json* find(const std::string& key) const {
+    if (type_ != Type::Object) return nullptr;
+    auto it = obj_.find(key);
+    return it == obj_.end() ? nullptr : &it->second;
+  }
+
+  // Canonical serialization (sorted keys, no spaces, \uXXXX escapes).
+  std::string dump() const;
+
+  // Returns nullopt on malformed input.
+  static std::optional<Json> parse(const std::string& text);
+
+ private:
+  Type type_;
+  int64_t int_ = 0;
+  double dbl_ = 0;
+  std::string str_;
+  JsonObject obj_;
+  JsonArray arr_;
+};
+
+}  // namespace pbft

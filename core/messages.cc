@@ -1,0 +1,215 @@
+#include "messages.h"
+
+#include <cstring>
+
+#include "blake2b.h"
+
+namespace pbft {
+
+std::string to_hex(const uint8_t* data, size_t n) {
+  static const char* kHex = "0123456789abcdef";
+  std::string out;
+  out.reserve(n * 2);
+  for (size_t i = 0; i < n; ++i) {
+    out.push_back(kHex[data[i] >> 4]);
+    out.push_back(kHex[data[i] & 0xF]);
+  }
+  return out;
+}
+
+bool from_hex(const std::string& hex, uint8_t* out, size_t n) {
+  if (hex.size() != n * 2) return false;
+  auto nib = [](char c) -> int {
+    if (c >= '0' && c <= '9') return c - '0';
+    if (c >= 'a' && c <= 'f') return c - 'a' + 10;
+    if (c >= 'A' && c <= 'F') return c - 'A' + 10;
+    return -1;
+  };
+  for (size_t i = 0; i < n; ++i) {
+    int hi = nib(hex[2 * i]), lo = nib(hex[2 * i + 1]);
+    if (hi < 0 || lo < 0) return false;
+    out[i] = (uint8_t)((hi << 4) | lo);
+  }
+  return true;
+}
+
+Json ClientRequest::to_json(bool with_type) const {
+  JsonObject o;
+  o.emplace("client", client);
+  o.emplace("operation", operation);
+  o.emplace("timestamp", timestamp);
+  if (with_type) o.emplace("type", "client-request");
+  return Json(std::move(o));
+}
+
+std::string ClientRequest::digest_hex() const {
+  std::string bytes = canonical();
+  uint8_t d[32];
+  blake2b_256(d, (const uint8_t*)bytes.data(), bytes.size());
+  return to_hex(d, 32);
+}
+
+Json ClientReply::to_json() const {
+  JsonObject o;
+  o.emplace("client", client);
+  o.emplace("replica", replica);
+  o.emplace("result", result);
+  o.emplace("timestamp", timestamp);
+  o.emplace("type", "client-reply");
+  o.emplace("view", view);
+  return Json(std::move(o));
+}
+
+Json PrePrepare::to_json() const {
+  JsonObject o;
+  o.emplace("digest", digest);
+  o.emplace("replica", replica);
+  o.emplace("request", request.to_json(/*with_type=*/false));
+  o.emplace("seq", seq);
+  o.emplace("sig", sig);
+  o.emplace("type", "pre-prepare");
+  o.emplace("view", view);
+  return Json(std::move(o));
+}
+
+Json Prepare::to_json() const {
+  JsonObject o;
+  o.emplace("digest", digest);
+  o.emplace("replica", replica);
+  o.emplace("seq", seq);
+  o.emplace("sig", sig);
+  o.emplace("type", "prepare");
+  o.emplace("view", view);
+  return Json(std::move(o));
+}
+
+Json Commit::to_json() const {
+  JsonObject o;
+  o.emplace("digest", digest);
+  o.emplace("replica", replica);
+  o.emplace("seq", seq);
+  o.emplace("sig", sig);
+  o.emplace("type", "commit");
+  o.emplace("view", view);
+  return Json(std::move(o));
+}
+
+Json Checkpoint::to_json() const {
+  JsonObject o;
+  o.emplace("digest", digest);
+  o.emplace("replica", replica);
+  o.emplace("seq", seq);
+  o.emplace("sig", sig);
+  o.emplace("type", "checkpoint");
+  return Json(std::move(o));
+}
+
+MsgType type_of(const Message& m) {
+  return static_cast<MsgType>(m.index());
+}
+
+Json message_to_json(const Message& m) {
+  return std::visit([](const auto& v) { return v.to_json(); }, m);
+}
+
+std::string message_canonical(const Message& m) {
+  return message_to_json(m).dump();
+}
+
+void message_signable(const Message& m, uint8_t out[32]) {
+  Json j = message_to_json(m);
+  j.as_object().erase("sig");
+  std::string bytes = j.dump();
+  blake2b_256(out, (const uint8_t*)bytes.data(), bytes.size());
+}
+
+namespace {
+
+bool get_str(const Json& j, const char* key, std::string* out) {
+  const Json* v = j.find(key);
+  if (!v || !v->is_string()) return false;
+  *out = v->as_string();
+  return true;
+}
+
+bool get_int(const Json& j, const char* key, int64_t* out) {
+  const Json* v = j.find(key);
+  if (!v || !v->is_int()) return false;
+  *out = v->as_int();
+  return true;
+}
+
+bool parse_request_fields(const Json& j, ClientRequest* r) {
+  return get_str(j, "operation", &r->operation) &&
+         get_int(j, "timestamp", &r->timestamp) &&
+         get_str(j, "client", &r->client);
+}
+
+}  // namespace
+
+std::optional<Message> message_from_json(const Json& j) {
+  std::string type;
+  if (!j.is_object() || !get_str(j, "type", &type)) return std::nullopt;
+  if (type == "client-request") {
+    ClientRequest r;
+    if (!parse_request_fields(j, &r)) return std::nullopt;
+    return Message(std::move(r));
+  }
+  if (type == "client-reply") {
+    ClientReply r;
+    if (!get_int(j, "view", &r.view) || !get_int(j, "timestamp", &r.timestamp) ||
+        !get_str(j, "client", &r.client) || !get_int(j, "replica", &r.replica) ||
+        !get_str(j, "result", &r.result))
+      return std::nullopt;
+    return Message(std::move(r));
+  }
+  if (type == "pre-prepare") {
+    PrePrepare r;
+    const Json* req = j.find("request");
+    if (!req || !req->is_object() || !parse_request_fields(*req, &r.request) ||
+        !get_int(j, "view", &r.view) || !get_int(j, "seq", &r.seq) ||
+        !get_str(j, "digest", &r.digest) || !get_int(j, "replica", &r.replica) ||
+        !get_str(j, "sig", &r.sig))
+      return std::nullopt;
+    return Message(std::move(r));
+  }
+  if (type == "prepare" || type == "commit") {
+    Prepare r;
+    if (!get_int(j, "view", &r.view) || !get_int(j, "seq", &r.seq) ||
+        !get_str(j, "digest", &r.digest) || !get_int(j, "replica", &r.replica) ||
+        !get_str(j, "sig", &r.sig))
+      return std::nullopt;
+    if (type == "prepare") return Message(std::move(r));
+    Commit c{r.view, r.seq, r.digest, r.replica, r.sig};
+    return Message(std::move(c));
+  }
+  if (type == "checkpoint") {
+    Checkpoint r;
+    if (!get_int(j, "seq", &r.seq) || !get_str(j, "digest", &r.digest) ||
+        !get_int(j, "replica", &r.replica) || !get_str(j, "sig", &r.sig))
+      return std::nullopt;
+    return Message(std::move(r));
+  }
+  return std::nullopt;
+}
+
+std::string to_wire(const Message& m) {
+  std::string payload = message_canonical(m);
+  std::string frame;
+  frame.reserve(payload.size() + 4);
+  uint32_t n = (uint32_t)payload.size();
+  frame.push_back((char)(n >> 24));
+  frame.push_back((char)(n >> 16));
+  frame.push_back((char)(n >> 8));
+  frame.push_back((char)n);
+  frame += payload;
+  return frame;
+}
+
+std::optional<Message> from_payload(const std::string& payload) {
+  auto j = Json::parse(payload);
+  if (!j) return std::nullopt;
+  return message_from_json(*j);
+}
+
+}  // namespace pbft

@@ -1,0 +1,109 @@
+// PBFT message structs + canonical encoding + digests + signatures (C++).
+//
+// Byte-identical to pbft_tpu/consensus/messages.py: canonical bytes are
+// sorted-key JSON, the content digest is Blake2b-256 of the standalone
+// client-request encoding (the reference also digested the request with
+// Blake2b, reference src/message.rs:209-212), and replicas sign the 32-byte
+// Blake2b digest of a message's signable content (signature field excluded).
+// Wire frame: 4-byte big-endian length + JSON.
+#pragma once
+
+#include <cstdint>
+#include <optional>
+#include <string>
+#include <variant>
+
+#include "json.h"
+
+namespace pbft {
+
+enum class MsgType {
+  kClientRequest,
+  kClientReply,
+  kPrePrepare,
+  kPrepare,
+  kCommit,
+  kCheckpoint,
+};
+
+struct ClientRequest {
+  std::string operation;
+  int64_t timestamp = 0;
+  std::string client;  // dial-back "host:port"
+
+  Json to_json(bool with_type = true) const;
+  std::string canonical() const { return to_json().dump(); }
+  // Blake2b-256 hex of canonical bytes.
+  std::string digest_hex() const;
+};
+
+struct ClientReply {
+  int64_t view = 0;
+  int64_t timestamp = 0;
+  std::string client;
+  int64_t replica = 0;
+  std::string result;
+
+  Json to_json() const;
+};
+
+struct PrePrepare {
+  int64_t view = 0;
+  int64_t seq = 0;
+  std::string digest;
+  ClientRequest request;
+  int64_t replica = 0;
+  std::string sig;  // hex
+
+  Json to_json() const;
+};
+
+struct Prepare {
+  int64_t view = 0;
+  int64_t seq = 0;
+  std::string digest;
+  int64_t replica = 0;
+  std::string sig;
+
+  Json to_json() const;
+};
+
+struct Commit {
+  int64_t view = 0;
+  int64_t seq = 0;
+  std::string digest;
+  int64_t replica = 0;
+  std::string sig;
+
+  Json to_json() const;
+};
+
+struct Checkpoint {
+  int64_t seq = 0;
+  std::string digest;
+  int64_t replica = 0;
+  std::string sig;
+
+  Json to_json() const;
+};
+
+using Message = std::variant<ClientRequest, ClientReply, PrePrepare, Prepare,
+                             Commit, Checkpoint>;
+
+MsgType type_of(const Message& m);
+Json message_to_json(const Message& m);
+std::string message_canonical(const Message& m);
+// 32-byte Blake2b digest of canonical content with "sig" removed.
+void message_signable(const Message& m, uint8_t out[32]);
+std::optional<Message> message_from_json(const Json& j);
+
+// Wire framing: u32 big-endian length prefix + canonical JSON.
+std::string to_wire(const Message& m);
+// Parses a complete frame payload (without the length prefix).
+std::optional<Message> from_payload(const std::string& payload);
+
+// hex helpers
+std::string to_hex(const uint8_t* data, size_t n);
+bool from_hex(const std::string& hex, uint8_t* out, size_t n);
+
+}  // namespace pbft

@@ -1,0 +1,363 @@
+#include "replica.h"
+
+#include <cstring>
+
+#include "blake2b.h"
+#include "ed25519.h"
+
+namespace pbft {
+
+void Actions::merge(Actions&& other) {
+  for (auto& s : other.sends) sends.push_back(std::move(s));
+  for (auto& b : other.broadcasts) broadcasts.push_back(std::move(b));
+  for (auto& r : other.replies) replies.push_back(std::move(r));
+}
+
+std::optional<ClusterConfig> ClusterConfig::from_json_text(
+    const std::string& text) {
+  auto j = Json::parse(text);
+  if (!j || !j->is_object()) return std::nullopt;
+  ClusterConfig cfg;
+  if (const Json* v = j->find("watermark_window")) cfg.watermark_window = v->as_int();
+  if (const Json* v = j->find("checkpoint_interval"))
+    cfg.checkpoint_interval = v->as_int();
+  if (const Json* v = j->find("batch_pad")) cfg.batch_pad = v->as_int();
+  if (const Json* v = j->find("verifier"); v && v->is_string())
+    cfg.verifier = v->as_string();
+  const Json* reps = j->find("replicas");
+  if (!reps || !reps->is_array()) return std::nullopt;
+  for (const Json& r : reps->as_array()) {
+    ReplicaIdentity id;
+    const Json* rid = r.find("replica_id");
+    const Json* host = r.find("host");
+    const Json* port = r.find("port");
+    const Json* pk = r.find("pubkey");
+    if (!rid || !host || !port || !pk) return std::nullopt;
+    id.replica_id = rid->as_int();
+    id.host = host->as_string();
+    id.port = (int)port->as_int();
+    if (!from_hex(pk->as_string(), id.pubkey, 32)) return std::nullopt;
+    cfg.replicas.push_back(std::move(id));
+  }
+  return cfg;
+}
+
+Replica::Replica(ClusterConfig config, int64_t replica_id,
+                 const uint8_t seed[32])
+    : config_(std::move(config)), id_(replica_id) {
+  std::memcpy(seed_, seed, 32);
+  static const char* kGenesis = "pbft-genesis";
+  blake2b_256(state_digest_, (const uint8_t*)kGenesis, std::strlen(kGenesis));
+  for (const char* name :
+       {"sig_verified", "sig_rejected", "pre_prepares_accepted",
+        "prepares_accepted", "commits_accepted", "executed",
+        "duplicate_requests", "checkpoints_stable"}) {
+    counters[name] = 0;
+  }
+}
+
+template <typename M>
+M Replica::sign(M msg) const {
+  uint8_t digest[32], sig[64];
+  message_signable(Message(msg), digest);
+  ed25519_sign(sig, seed_, digest, 32);
+  msg.sig = to_hex(sig, 64);
+  return msg;
+}
+
+Actions Replica::on_client_request(const ClientRequest& req) {
+  Actions out;
+  if (!is_primary()) {
+    out.sends.push_back({primary(), Message(req)});
+    return out;
+  }
+  auto it = last_timestamp_.find(req.client);
+  if (it != last_timestamp_.end() && req.timestamp <= it->second) {
+    counters["duplicate_requests"] += 1;
+    auto cached = last_reply_.find(req.client);
+    if (cached != last_reply_.end() &&
+        cached->second.timestamp == req.timestamp) {
+      out.replies.push_back({req.client, cached->second});
+    }
+    return out;
+  }
+  if (seq_counter_ + 1 > high_mark()) return out;  // window closed
+  seq_counter_ += 1;
+  PrePrepare pp;
+  pp.view = view_;
+  pp.seq = seq_counter_;
+  pp.digest = req.digest_hex();
+  pp.request = req;
+  pp.replica = id_;
+  pp = sign(pp);
+  out.broadcasts.push_back({Message(pp)});
+  out.merge(accept_pre_prepare(pp));
+  return out;
+}
+
+Actions Replica::receive(const Message& msg) {
+  if (std::holds_alternative<ClientRequest>(msg)) {
+    return on_client_request(std::get<ClientRequest>(msg));
+  }
+  inbox_.push_back(msg);
+  return {};
+}
+
+namespace {
+int64_t replica_of(const Message& m) {
+  if (auto* pp = std::get_if<PrePrepare>(&m)) return pp->replica;
+  if (auto* p = std::get_if<Prepare>(&m)) return p->replica;
+  if (auto* c = std::get_if<Commit>(&m)) return c->replica;
+  if (auto* cp = std::get_if<Checkpoint>(&m)) return cp->replica;
+  return -1;
+}
+const std::string* sig_of(const Message& m) {
+  if (auto* pp = std::get_if<PrePrepare>(&m)) return &pp->sig;
+  if (auto* p = std::get_if<Prepare>(&m)) return &p->sig;
+  if (auto* c = std::get_if<Commit>(&m)) return &c->sig;
+  if (auto* cp = std::get_if<Checkpoint>(&m)) return &cp->sig;
+  return nullptr;
+}
+}  // namespace
+
+std::vector<VerifyItem> Replica::pending_items() const {
+  std::vector<VerifyItem> items;
+  items.reserve(inbox_.size());
+  for (const Message& msg : inbox_) {
+    VerifyItem item{};
+    int64_t rid = replica_of(msg);
+    if (rid >= 0 && rid < config_.n()) {
+      std::memcpy(item.pub, config_.replicas[rid].pubkey, 32);
+    }
+    message_signable(msg, item.msg);
+    const std::string* sig = sig_of(msg);
+    if (!sig || !from_hex(*sig, item.sig, 64)) {
+      std::memset(item.sig, 0, 64);  // guaranteed invalid
+    }
+    items.push_back(item);
+  }
+  return items;
+}
+
+Actions Replica::deliver_verdicts(const std::vector<uint8_t>& verdicts) {
+  Actions out;
+  size_t n = std::min(verdicts.size(), inbox_.size());
+  for (size_t i = 0; i < n; ++i) {
+    Message msg = std::move(inbox_.front());
+    inbox_.pop_front();
+    if (!verdicts[i]) {
+      counters["sig_rejected"] += 1;
+      continue;
+    }
+    counters["sig_verified"] += 1;
+    out.merge(dispatch(msg));
+  }
+  return out;
+}
+
+Actions Replica::dispatch(const Message& msg) {
+  if (auto* pp = std::get_if<PrePrepare>(&msg)) return on_pre_prepare(*pp);
+  if (auto* p = std::get_if<Prepare>(&msg)) return on_prepare(*p);
+  if (auto* c = std::get_if<Commit>(&msg)) return on_commit(*c);
+  if (auto* cp = std::get_if<Checkpoint>(&msg)) return on_checkpoint(*cp);
+  if (auto* r = std::get_if<ClientRequest>(&msg)) return on_client_request(*r);
+  return {};
+}
+
+Actions Replica::on_pre_prepare(const PrePrepare& pp) {
+  if (pp.view != view_ || pp.replica != primary()) return {};
+  if (pp.request.digest_hex() != pp.digest) return {};
+  if (!in_window(pp.seq)) return {};
+  if (pre_prepares_.count({pp.view, pp.seq})) return {};
+  return accept_pre_prepare(pp);
+}
+
+Actions Replica::accept_pre_prepare(const PrePrepare& pp) {
+  Key key{pp.view, pp.seq};
+  pre_prepares_.emplace(key, pp);
+  counters["pre_prepares_accepted"] += 1;
+  Prepare prep;
+  prep.view = pp.view;
+  prep.seq = pp.seq;
+  prep.digest = pp.digest;
+  prep.replica = id_;
+  prep = sign(prep);
+  Actions out;
+  out.broadcasts.push_back({Message(prep)});
+  out.merge(insert_prepare(prep));
+  return out;
+}
+
+Actions Replica::on_prepare(const Prepare& p) {
+  if (p.view != view_ || !in_window(p.seq)) return {};
+  return insert_prepare(p);
+}
+
+Actions Replica::insert_prepare(const Prepare& p) {
+  Key key{p.view, p.seq};
+  auto& slot = prepares_[key];
+  if (slot.count(p.replica)) return {};
+  slot.emplace(p.replica, p);
+  counters["prepares_accepted"] += 1;
+  return maybe_commit(key);
+}
+
+bool Replica::prepared(const Key& key) const {
+  auto pp = pre_prepares_.find(key);
+  if (pp == pre_prepares_.end()) return false;
+  auto slot = prepares_.find(key);
+  if (slot == prepares_.end()) return false;
+  int64_t matching = 0;
+  for (const auto& [rid, p] : slot->second) {
+    if (p.digest == pp->second.digest) matching += 1;
+  }
+  return matching >= 2 * config_.f();
+}
+
+Actions Replica::maybe_commit(const Key& key) {
+  if (sent_commit_.count(key) || !prepared(key)) return {};
+  sent_commit_.insert(key);
+  Commit cm;
+  cm.view = key.first;
+  cm.seq = key.second;
+  cm.digest = pre_prepares_.at(key).digest;
+  cm.replica = id_;
+  cm = sign(cm);
+  Actions out;
+  out.broadcasts.push_back({Message(cm)});
+  out.merge(insert_commit(cm));
+  return out;
+}
+
+Actions Replica::on_commit(const Commit& c) {
+  if (c.view != view_ || !in_window(c.seq)) return {};
+  return insert_commit(c);
+}
+
+Actions Replica::insert_commit(const Commit& c) {
+  Key key{c.view, c.seq};
+  auto& slot = commits_[key];
+  if (slot.count(c.replica)) return {};
+  slot.emplace(c.replica, c);
+  counters["commits_accepted"] += 1;
+  return maybe_execute(key);
+}
+
+bool Replica::committed_local(const Key& key) const {
+  if (!prepared(key)) return false;
+  auto pp = pre_prepares_.find(key);
+  auto slot = commits_.find(key);
+  if (slot == commits_.end()) return false;
+  int64_t matching = 0;
+  for (const auto& [rid, c] : slot->second) {
+    if (c.digest == pp->second.digest) matching += 1;
+  }
+  return matching >= 2 * config_.f() + 1;
+}
+
+Actions Replica::maybe_execute(const Key& key) {
+  if (!committed_local(key)) return {};
+  int64_t seq = key.second;
+  if (seq <= executed_upto_ || pending_execution_.count(seq)) return {};
+  pending_execution_[seq] = {key.first, pre_prepares_.at(key).digest};
+  return drain_executions();
+}
+
+Actions Replica::drain_executions() {
+  Actions out;
+  while (pending_execution_.count(executed_upto_ + 1)) {
+    int64_t seq = executed_upto_ + 1;
+    auto [view, digest] = pending_execution_[seq];
+    pending_execution_.erase(seq);
+    auto ppit = pre_prepares_.find({view, seq});
+    if (ppit == pre_prepares_.end()) {
+      executed_upto_ = seq;  // truncated past us; needs state transfer
+      continue;
+    }
+    const ClientRequest& req = ppit->second.request;
+    executed_upto_ = seq;
+    auto it = last_timestamp_.find(req.client);
+    if (it != last_timestamp_.end() && req.timestamp <= it->second) {
+      counters["duplicate_requests"] += 1;
+      continue;
+    }
+    // Execution: the reference's app is a no-op returning "awesome!"
+    // (reference src/message.rs:70); kept as the built-in app.
+    std::string result = "awesome!";
+    counters["executed"] += 1;
+    {
+      std::vector<uint8_t> buf(state_digest_, state_digest_ + 32);
+      buf.insert(buf.end(), result.begin(), result.end());
+      for (int i = 7; i >= 0; --i) buf.push_back((uint8_t)(seq >> (8 * i)));
+      blake2b_256(state_digest_, buf.data(), buf.size());
+    }
+    last_timestamp_[req.client] = req.timestamp;
+    ClientReply reply;
+    reply.view = view;
+    reply.timestamp = req.timestamp;
+    reply.client = req.client;
+    reply.replica = id_;
+    reply.result = result;
+    last_reply_[req.client] = reply;
+    out.replies.push_back({req.client, reply});
+    if (seq % config_.checkpoint_interval == 0) {
+      Checkpoint cp;
+      cp.seq = seq;
+      cp.digest = to_hex(state_digest_, 32);
+      cp.replica = id_;
+      cp = sign(cp);
+      out.broadcasts.push_back({Message(cp)});
+      out.merge(insert_checkpoint(cp));
+    }
+  }
+  return out;
+}
+
+Actions Replica::on_checkpoint(const Checkpoint& cp) {
+  if (cp.seq <= low_mark_) return {};
+  return insert_checkpoint(cp);
+}
+
+Actions Replica::insert_checkpoint(const Checkpoint& cp) {
+  auto& slot = checkpoints_[cp.seq];
+  if (slot.count(cp.replica)) return {};
+  slot.emplace(cp.replica, cp);
+  std::map<std::string, int64_t> by_digest;
+  for (const auto& [rid, c] : slot) by_digest[c.digest] += 1;
+  for (const auto& [d, count] : by_digest) {
+    if (count >= 2 * config_.f() + 1) {
+      advance_watermark(cp.seq);
+      break;
+    }
+  }
+  return {};
+}
+
+void Replica::advance_watermark(int64_t stable_seq) {
+  if (stable_seq <= low_mark_) return;
+  low_mark_ = stable_seq;
+  counters["checkpoints_stable"] += 1;
+  auto prune_keys = [stable_seq](auto& log) {
+    for (auto it = log.begin(); it != log.end();) {
+      if (it->first.second <= stable_seq) it = log.erase(it);
+      else ++it;
+    }
+  };
+  prune_keys(pre_prepares_);
+  prune_keys(prepares_);
+  prune_keys(commits_);
+  for (auto it = sent_commit_.begin(); it != sent_commit_.end();) {
+    if (it->second <= stable_seq) it = sent_commit_.erase(it);
+    else ++it;
+  }
+  for (auto it = checkpoints_.begin(); it != checkpoints_.end();) {
+    if (it->first <= stable_seq) it = checkpoints_.erase(it);
+    else ++it;
+  }
+  for (auto it = pending_execution_.begin(); it != pending_execution_.end();) {
+    if (it->first <= stable_seq) it = pending_execution_.erase(it);
+    else ++it;
+  }
+}
+
+}  // namespace pbft

@@ -1,0 +1,72 @@
+#include "sha512.h"
+
+#include <cstring>
+
+namespace pbft {
+namespace {
+
+constexpr uint64_t kK[80] = {
+#include "sha512_k.inc"
+};
+
+constexpr uint64_t kH0[8] = {
+    0x6a09e667f3bcc908ULL, 0xbb67ae8584caa73bULL, 0x3c6ef372fe94f82bULL,
+    0xa54ff53a5f1d36f1ULL, 0x510e527fade682d1ULL, 0x9b05688c2b3e6c1fULL,
+    0x1f83d9abfb41bd6bULL, 0x5be0cd19137e2179ULL};
+
+inline uint64_t rotr(uint64_t x, int n) { return (x >> n) | (x << (64 - n)); }
+inline uint64_t load_be64(const uint8_t* p) {
+  uint64_t v = 0;
+  for (int i = 0; i < 8; ++i) v = (v << 8) | p[i];
+  return v;
+}
+
+void compress(uint64_t h[8], const uint8_t block[128]) {
+  uint64_t w[80];
+  for (int t = 0; t < 16; ++t) w[t] = load_be64(block + 8 * t);
+  for (int t = 16; t < 80; ++t) {
+    uint64_t s0 = rotr(w[t - 15], 1) ^ rotr(w[t - 15], 8) ^ (w[t - 15] >> 7);
+    uint64_t s1 = rotr(w[t - 2], 19) ^ rotr(w[t - 2], 61) ^ (w[t - 2] >> 6);
+    w[t] = w[t - 16] + s0 + w[t - 7] + s1;
+  }
+  uint64_t a = h[0], b = h[1], c = h[2], d = h[3];
+  uint64_t e = h[4], f = h[5], g = h[6], hh = h[7];
+  for (int t = 0; t < 80; ++t) {
+    uint64_t S1 = rotr(e, 14) ^ rotr(e, 18) ^ rotr(e, 41);
+    uint64_t ch = (e & f) ^ (~e & g);
+    uint64_t t1 = hh + S1 + ch + kK[t] + w[t];
+    uint64_t S0 = rotr(a, 28) ^ rotr(a, 34) ^ rotr(a, 39);
+    uint64_t maj = (a & b) ^ (a & c) ^ (b & c);
+    uint64_t t2 = S0 + maj;
+    hh = g; g = f; f = e; e = d + t1;
+    d = c; c = b; b = a; a = t1 + t2;
+  }
+  h[0] += a; h[1] += b; h[2] += c; h[3] += d;
+  h[4] += e; h[5] += f; h[6] += g; h[7] += hh;
+}
+
+}  // namespace
+
+void sha512(uint8_t out[64], const uint8_t* in, size_t inlen) {
+  uint64_t h[8];
+  std::memcpy(h, kH0, sizeof(h));
+  size_t rem = inlen;
+  while (rem >= 128) {
+    compress(h, in + (inlen - rem));
+    rem -= 128;
+  }
+  uint8_t block[256] = {0};
+  std::memcpy(block, in + (inlen - rem), rem);
+  block[rem] = 0x80;
+  size_t nblocks = (rem + 1 + 16 <= 128) ? 1 : 2;
+  uint64_t bits = static_cast<uint64_t>(inlen) * 8;
+  uint8_t* lenp = block + nblocks * 128 - 8;
+  for (int i = 0; i < 8; ++i) lenp[i] = static_cast<uint8_t>(bits >> (56 - 8 * i));
+  compress(h, block);
+  if (nblocks == 2) compress(h, block + 128);
+  for (int i = 0; i < 8; ++i)
+    for (int j = 0; j < 8; ++j)
+      out[8 * i + j] = static_cast<uint8_t>(h[i] >> (56 - 8 * j));
+}
+
+}  // namespace pbft

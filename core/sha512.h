@@ -1,0 +1,11 @@
+// SHA-512 (FIPS 180-4) for the C++ Ed25519 path (challenge hash + signing).
+#pragma once
+
+#include <cstddef>
+#include <cstdint>
+
+namespace pbft {
+
+void sha512(uint8_t out[64], const uint8_t* in, size_t inlen);
+
+}  // namespace pbft

@@ -1,0 +1,112 @@
+#include "verifier.h"
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <sys/un.h>
+#include <unistd.h>
+
+#include <cstring>
+
+#include "ed25519.h"
+
+namespace pbft {
+
+std::vector<uint8_t> CpuVerifier::verify_batch(
+    const std::vector<VerifyItem>& items) {
+  std::vector<uint8_t> out(items.size());
+  for (size_t i = 0; i < items.size(); ++i) {
+    out[i] = ed25519_verify(items[i].pub, items[i].msg, 32, items[i].sig) ? 1 : 0;
+  }
+  return out;
+}
+
+RemoteVerifier::RemoteVerifier(std::string target) : target_(std::move(target)) {}
+
+RemoteVerifier::~RemoteVerifier() {
+  if (fd_ >= 0) ::close(fd_);
+}
+
+bool RemoteVerifier::ensure_connected() {
+  if (fd_ >= 0) return true;
+  if (!target_.empty() && target_[0] == '/') {
+    fd_ = ::socket(AF_UNIX, SOCK_STREAM, 0);
+    if (fd_ < 0) return false;
+    sockaddr_un addr{};
+    addr.sun_family = AF_UNIX;
+    std::strncpy(addr.sun_path, target_.c_str(), sizeof(addr.sun_path) - 1);
+    if (::connect(fd_, (sockaddr*)&addr, sizeof(addr)) != 0) {
+      ::close(fd_);
+      fd_ = -1;
+      return false;
+    }
+    return true;
+  }
+  auto colon = target_.rfind(':');
+  if (colon == std::string::npos) return false;
+  std::string host = target_.substr(0, colon);
+  int port = std::atoi(target_.c_str() + colon + 1);
+  fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd_ < 0) return false;
+  int one = 1;
+  ::setsockopt(fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons((uint16_t)port);
+  if (::inet_pton(AF_INET, host.c_str(), &addr.sin_addr) != 1 ||
+      ::connect(fd_, (sockaddr*)&addr, sizeof(addr)) != 0) {
+    ::close(fd_);
+    fd_ = -1;
+    return false;
+  }
+  return true;
+}
+
+static bool write_all(int fd, const uint8_t* data, size_t n) {
+  while (n > 0) {
+    ssize_t w = ::write(fd, data, n);
+    if (w <= 0) return false;
+    data += w;
+    n -= (size_t)w;
+  }
+  return true;
+}
+
+static bool read_all(int fd, uint8_t* data, size_t n) {
+  while (n > 0) {
+    ssize_t r = ::read(fd, data, n);
+    if (r <= 0) return false;
+    data += r;
+    n -= (size_t)r;
+  }
+  return true;
+}
+
+std::vector<uint8_t> RemoteVerifier::verify_batch(
+    const std::vector<VerifyItem>& items) {
+  if (items.empty()) return {};
+  if (!ensure_connected()) return fallback_.verify_batch(items);
+  const uint32_t n = (uint32_t)items.size();
+  std::vector<uint8_t> buf(4 + n * 128);
+  buf[0] = (uint8_t)(n >> 24);
+  buf[1] = (uint8_t)(n >> 16);
+  buf[2] = (uint8_t)(n >> 8);
+  buf[3] = (uint8_t)n;
+  for (uint32_t i = 0; i < n; ++i) {
+    uint8_t* p = buf.data() + 4 + i * 128;
+    std::memcpy(p, items[i].pub, 32);
+    std::memcpy(p + 32, items[i].msg, 32);
+    std::memcpy(p + 64, items[i].sig, 64);
+  }
+  std::vector<uint8_t> out(n);
+  if (!write_all(fd_, buf.data(), buf.size()) ||
+      !read_all(fd_, out.data(), n)) {
+    ::close(fd_);
+    fd_ = -1;
+    return fallback_.verify_batch(items);
+  }
+  return out;
+}
+
+}  // namespace pbft

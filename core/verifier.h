@@ -1,0 +1,54 @@
+// Pluggable signature-verifier backends (BASELINE.json north_star):
+// `Verifier::verify_batch(items) -> bitmap`.
+//
+// - CpuVerifier: in-process per-item Ed25519 (core/ed25519.cc) — the control
+//   arm (BASELINE.md configs 1-2).
+// - RemoteVerifier: ships (pubkey, digest, sig) batches over a local socket
+//   to the colocated JAX/TPU service (pbft_tpu/net/service.py), which runs
+//   one vmap'd XLA launch per batch and returns the validity bitmap.
+//   Protocol: u32be count, then count * (32+32+64) bytes; reply = count
+//   bytes of 0/1. Falls back to CPU when the service is unreachable so a
+//   verifier outage degrades throughput, not safety/liveness.
+#pragma once
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+namespace pbft {
+
+struct VerifyItem {
+  uint8_t pub[32];
+  uint8_t msg[32];
+  uint8_t sig[64];
+};
+
+class Verifier {
+ public:
+  virtual ~Verifier() = default;
+  virtual std::vector<uint8_t> verify_batch(
+      const std::vector<VerifyItem>& items) = 0;
+};
+
+class CpuVerifier : public Verifier {
+ public:
+  std::vector<uint8_t> verify_batch(
+      const std::vector<VerifyItem>& items) override;
+};
+
+class RemoteVerifier : public Verifier {
+ public:
+  // target: "host:port" TCP or a unix socket path ("/...").
+  explicit RemoteVerifier(std::string target);
+  ~RemoteVerifier() override;
+  std::vector<uint8_t> verify_batch(
+      const std::vector<VerifyItem>& items) override;
+
+ private:
+  bool ensure_connected();
+  std::string target_;
+  int fd_ = -1;
+  CpuVerifier fallback_;
+};
+
+}  // namespace pbft

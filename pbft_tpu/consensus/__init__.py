@@ -1,0 +1,19 @@
+"""The deterministic PBFT consensus core.
+
+Pure message-in/message-out state machine (no sockets, no threads, no clocks)
+so it unit-tests as truth tables (SURVEY.md §4 item 1) and drives identically
+under the in-memory simulation, the C++ runtime, and multi-process clusters.
+"""
+
+from .messages import (
+    ClientRequest,
+    ClientReply,
+    PrePrepare,
+    Prepare,
+    Commit,
+    Checkpoint,
+    from_wire,
+    to_wire,
+)
+from .config import ClusterConfig, ReplicaIdentity
+from .replica import Replica

@@ -1,0 +1,96 @@
+"""Cluster configuration: the rebuild makes network.json real.
+
+The reference shipped a network.json (4 nodes, ports 8000-8003, primary 8000)
+that no code ever read (SURVEY.md §2 "Static topology config"); here it is the
+actual source of truth for replica identities, keys, f, the batching window,
+and the verifier backend selection.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import List, Optional
+
+from ..crypto import ref as crypto_ref
+from .messages import blake2b_256
+
+
+@dataclasses.dataclass(frozen=True)
+class ReplicaIdentity:
+    replica_id: int
+    host: str
+    port: int
+    pubkey: str  # hex
+
+    def pubkey_bytes(self) -> bytes:
+        return bytes.fromhex(self.pubkey)
+
+
+@dataclasses.dataclass
+class ClusterConfig:
+    replicas: List[ReplicaIdentity]
+    watermark_window: int = 256
+    checkpoint_interval: int = 16
+    batch_pad: int = 64  # padded batch size fed to the TPU verifier
+    verifier: str = "cpu"  # "cpu" | "tpu"
+
+    @property
+    def n(self) -> int:
+        return len(self.replicas)
+
+    @property
+    def f(self) -> int:
+        return (self.n - 1) // 3
+
+    def primary_of(self, view: int) -> int:
+        return view % self.n
+
+    def identity(self, replica_id: int) -> ReplicaIdentity:
+        return self.replicas[replica_id]
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {
+                "watermark_window": self.watermark_window,
+                "checkpoint_interval": self.checkpoint_interval,
+                "batch_pad": self.batch_pad,
+                "verifier": self.verifier,
+                "replicas": [dataclasses.asdict(r) for r in self.replicas],
+            },
+            indent=2,
+        )
+
+    @classmethod
+    def from_json(cls, text: str) -> "ClusterConfig":
+        d = json.loads(text)
+        return cls(
+            replicas=[ReplicaIdentity(**r) for r in d["replicas"]],
+            watermark_window=d.get("watermark_window", 256),
+            checkpoint_interval=d.get("checkpoint_interval", 16),
+            batch_pad=d.get("batch_pad", 64),
+            verifier=d.get("verifier", "cpu"),
+        )
+
+
+def make_local_cluster(
+    n: int, base_port: int = 8000, seed_prefix: bytes = b"pbft-tpu-replica-"
+):
+    """Deterministic localhost cluster for tests/simulation.
+
+    Returns (config, seeds): seeds[i] is replica i's Ed25519 seed. The
+    primary listens for clients on base_port, mirroring the reference's
+    fixed client port 8000 (reference src/client_handler.rs:22-28).
+    """
+    seeds = []
+    identities = []
+    for i in range(n):
+        seed = blake2b_256(seed_prefix + str(i).encode())
+        pub = crypto_ref.public_key(seed)
+        seeds.append(seed)
+        identities.append(
+            ReplicaIdentity(
+                replica_id=i, host="127.0.0.1", port=base_port + i, pubkey=pub.hex()
+            )
+        )
+    return ClusterConfig(replicas=identities), seeds

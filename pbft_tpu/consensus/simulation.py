@@ -1,0 +1,158 @@
+"""In-process cluster simulation: N replica cores over an in-memory transport.
+
+SURVEY.md §4 item 2 — the analogue of the reference's libp2p swarm for
+testing: byte-faithful message passing (frames go through to_wire/from_wire so
+encoding bugs can't hide), per-replica inboxes, pluggable signature-verifier
+backend (cpu oracle or the JAX batch kernel), link-failure and Byzantine
+fault injection.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..crypto import ref as crypto
+from .config import ClusterConfig, make_local_cluster
+from .messages import ClientReply, ClientRequest, Message, from_wire, to_wire
+from .replica import Broadcast, Replica, Reply, Send
+
+
+def cpu_verifier(items: List[Tuple[bytes, bytes, bytes]]) -> List[bool]:
+    """Per-message host verification — the control arm (BASELINE.md config 1)."""
+    return [crypto.verify(pub, msg, sig) for pub, msg, sig in items]
+
+
+def jax_verifier(items: List[Tuple[bytes, bytes, bytes]]) -> List[bool]:
+    """The batched XLA verifier (lazy import keeps sims jax-free on cpu arm)."""
+    from ..crypto import batch
+
+    return batch.verify_many(items)
+
+
+class Cluster:
+    def __init__(
+        self,
+        n: int = 4,
+        verifier: str | Callable = "cpu",
+        seed: int = 0,
+        shuffle: bool = False,
+        config: Optional[ClusterConfig] = None,
+        seeds: Optional[List[bytes]] = None,
+        app=None,
+    ):
+        if config is None:
+            config, seeds = make_local_cluster(n)
+        self.config = config
+        self.replicas = [
+            Replica(config, i, seeds[i], **({"app": app} if app else {}))
+            for i in range(config.n)
+        ]
+        self.inboxes: Dict[int, List[Message]] = {i: [] for i in range(config.n)}
+        self.client_replies: List[ClientReply] = []
+        self.rng = random.Random(seed)
+        self.shuffle = shuffle
+        self.dropped_links: set[Tuple[int, int]] = set()  # (src, dst)
+        # outbound_mutator(src, msg) -> Message | None; Byzantine injection.
+        self.outbound_mutator: Optional[Callable] = None
+        self.sig_verifications = 0
+        if callable(verifier):
+            self.verify = verifier
+        else:
+            self.verify = {"cpu": cpu_verifier, "jax": jax_verifier}[verifier]
+        self._timestamp = 0
+
+    # -- client side --------------------------------------------------------
+
+    def submit(
+        self,
+        operation: str,
+        client: str = "127.0.0.1:9000",
+        timestamp: Optional[int] = None,
+        to_replica: Optional[int] = None,
+    ) -> ClientRequest:
+        if timestamp is None:
+            self._timestamp += 1
+            timestamp = self._timestamp
+        req = ClientRequest(operation=operation, timestamp=timestamp, client=client)
+        dest = to_replica if to_replica is not None else self.primary_id
+        self._route(dest, dest, req)  # client link: no mutation, no drop
+        return req
+
+    @property
+    def primary_id(self) -> int:
+        return self.replicas[0].config.primary_of(self.replicas[0].view)
+
+    # -- transport ----------------------------------------------------------
+
+    def _route(self, src: int, dst: int, msg: Message) -> None:
+        frame = to_wire(msg)  # byte-faithful round trip on every hop
+        self.inboxes[dst].append(from_wire(frame[4:]))
+
+    def _emit(self, src: int, actions) -> None:
+        for act in actions:
+            if isinstance(act, Broadcast):
+                for dst in range(self.config.n):
+                    if dst != src:
+                        self._deliver(src, dst, act.msg)
+            elif isinstance(act, Send):
+                self._deliver(src, act.dest, act.msg)
+            elif isinstance(act, Reply):
+                self.client_replies.append(act.msg)
+
+    def _deliver(self, src: int, dst: int, msg: Message) -> None:
+        if (src, dst) in self.dropped_links:
+            return
+        if self.outbound_mutator is not None:
+            msg = self.outbound_mutator(src, msg)
+            if msg is None:
+                return
+        self._route(src, dst, msg)
+
+    # -- scheduler ----------------------------------------------------------
+
+    def step(self) -> bool:
+        """One round: every replica ingests its inbox, verifies the batch,
+        processes. Returns True if any message moved."""
+        moved = False
+        for rid, replica in enumerate(self.replicas):
+            queue, self.inboxes[rid] = self.inboxes[rid], []
+            if not queue:
+                continue
+            moved = True
+            if self.shuffle:
+                self.rng.shuffle(queue)
+            actions = []
+            for msg in queue:
+                actions.extend(replica.receive(msg))
+            items = replica.pending_items()
+            if items:
+                verdicts = self.verify(items)
+                self.sig_verifications += len(items)
+                actions.extend(replica.deliver_verdicts(verdicts))
+            self._emit(rid, actions)
+        return moved
+
+    def run(self, max_steps: int = 200) -> int:
+        steps = 0
+        while steps < max_steps and self.step():
+            steps += 1
+        return steps
+
+    # -- assertions helpers -------------------------------------------------
+
+    def replies_for(self, timestamp: int) -> List[ClientReply]:
+        return [r for r in self.client_replies if r.timestamp == timestamp]
+
+    def committed_result(self, timestamp: int, f: Optional[int] = None) -> str:
+        """The client's acceptance rule: f+1 matching replies (PBFT §4.1)."""
+        f = self.config.f if f is None else f
+        by_result: Dict[str, int] = {}
+        for r in self.replies_for(timestamp):
+            by_result[r.result] = by_result.get(r.result, 0) + 1
+        for result, count in by_result.items():
+            if count >= f + 1:
+                return result
+        raise AssertionError(
+            f"no f+1 quorum of matching replies for t={timestamp}: {by_result}"
+        )

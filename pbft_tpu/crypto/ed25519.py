@@ -1,0 +1,175 @@
+"""Ed25519 verification in JAX, built for one-XLA-launch batch verification.
+
+The consensus hot path (SURVEY.md §3.4-3.5): every PREPARE/COMMIT quorum needs
+2f / 2f+1 signatures verified. The reference left signature checks as TODOs
+(reference src/behavior.rs:127, :185); here they are the centerpiece, designed
+so a whole view-round's quorum certificates verify as one `jax.vmap` batch.
+
+Scalar pipeline per item (pub 32B, msg 32B digest, sig 64B = R||S):
+  1. h = SHA-512(R || pub || msg) reduced mod L      (sha512.py + field.py)
+  2. decompress pub -> A (reject non-canonical y, off-curve, x=0&sign)
+  3. P = [S]B + [h](-A) via a 256-step Shamir (joint double-scalar) ladder
+     over the 4-entry table {O, B, -A, B-A}, using complete extended
+     twisted-Edwards addition (a=-1, add-2008-hwcd-3) -- completeness means
+     no data-dependent branches, which is exactly what XLA wants.
+  4. valid = canonical(S) & ok(A) & (compress(P) == R)
+     (comparing compressed bytes rejects non-canonical R for free).
+
+Cofactorless equation, strict S < L: bit-for-bit the same accept set as the
+pure-Python oracle pbft_tpu.crypto.ref (RFC 8032).
+
+Points are tuples (X, Y, Z, T) of (..., 16)-limb field elements with
+T = XY/Z. All control flow is static; everything vmaps/jits.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+from jax import lax
+
+from . import field as F
+from . import ref
+from .sha512 import sha512
+
+# Static curve constants, as limb arrays (computed from the oracle's big
+# ints; ref.py is the RFC 8032 ground truth).
+_D = F.limbs_const(ref.D)
+_D2 = F.limbs_const(2 * ref.D % F.P)
+_SQRT_M1 = F.limbs_const(pow(2, (F.P - 1) // 4, F.P))
+_BX = F.limbs_const(ref.BASE[0])
+_BY = F.limbs_const(ref.BASE[1])
+_BT = F.limbs_const(ref.BASE[0] * ref.BASE[1] % F.P)
+_ONE = F.limbs_const(1)
+_ZERO = F.limbs_const(0)
+
+
+def identity(shape=()):
+    z = jnp.broadcast_to(jnp.asarray(_ZERO), shape + (16,))
+    o = jnp.broadcast_to(jnp.asarray(_ONE), shape + (16,))
+    return (z, o, o, z)
+
+
+def base_point(shape=()):
+    return tuple(
+        jnp.broadcast_to(jnp.asarray(c), shape + (16,))
+        for c in (_BX, _BY, _ONE, _BT)
+    )
+
+
+def point_add(p, q):
+    """Complete unified addition (a=-1 twisted Edwards, extended coords)."""
+    x1, y1, z1, t1 = p
+    x2, y2, z2, t2 = q
+    a = F.mul(F.sub(y1, x1), F.sub(y2, x2))
+    b = F.mul(F.add(y1, x1), F.add(y2, x2))
+    c = F.mul(F.mul(t1, jnp.asarray(_D2)), t2)
+    d = F.mul_small(F.mul(z1, z2), 2)
+    e = F.sub(b, a)
+    f = F.sub(d, c)
+    g = F.add(d, c)
+    h = F.add(b, a)
+    return (F.mul(e, f), F.mul(g, h), F.mul(f, g), F.mul(e, h))
+
+
+def point_neg(p):
+    x, y, z, t = p
+    return (F.neg(x), y, z, F.neg(t))
+
+
+def sqrt_ratio(u, v):
+    """(ok, r) with v*r^2 == u when ok; the p = 5 (mod 8) method."""
+    v2 = F.sqr(v)
+    v3 = F.mul(v, v2)
+    v7 = F.mul(v3, F.sqr(v2))
+    r = F.mul(F.mul(u, v3), F.pow_p58(F.mul(u, v7)))
+    check = F.mul(v, F.sqr(r))
+    ok_plus = F.eq(check, u)
+    ok_minus = F.eq(check, F.neg(u))
+    r = jnp.where(ok_minus[..., None], F.mul(r, jnp.asarray(_SQRT_M1)), r)
+    return ok_plus | ok_minus, r
+
+
+def decompress(ybytes):
+    """(…,32) uint8 -> (ok, point). RFC 8032 §5.1.3 decoding."""
+    ybytes = jnp.asarray(ybytes, jnp.uint8)
+    sign = (ybytes[..., 31] >> 7).astype(jnp.int64)
+    masked = ybytes.at[..., 31].set(ybytes[..., 31] & 0x7F)
+    y = F.bytes_to_limbs(masked)
+    # Canonical check: y < p.
+    b = jnp.zeros_like(y[..., 0])
+    for i in range(F.NLIMBS):
+        b = (y[..., i] - jnp.asarray(F._P_LIMBS)[i] + b) >> 16
+    ok_canon = b < 0
+    y2 = F.sqr(y)
+    u = F.sub(y2, jnp.asarray(_ONE))
+    v = F.add(F.mul(y2, jnp.asarray(_D)), jnp.asarray(_ONE))
+    ok_sqrt, x = sqrt_ratio(u, v)
+    x = F.canon(x)
+    x_zero = jnp.all(x == 0, axis=-1)
+    ok = ok_canon & ok_sqrt & ~(x_zero & (sign == 1))
+    flip = (x[..., 0] & 1) != sign
+    x = jnp.where(flip[..., None], F.neg(x), x)
+    one = jnp.broadcast_to(jnp.asarray(_ONE), y.shape)
+    return ok, (x, y, one, F.mul(x, y))
+
+
+def compress(p):
+    """Point -> (…,32) uint8 canonical encoding."""
+    x, y, z, _ = p
+    zi = F.inv(z)
+    xa = F.canon(F.mul(x, zi))
+    ybytes = F.limbs_to_bytes(F.mul(y, zi))
+    sign = (xa[..., 0] & 1).astype(jnp.uint8)
+    return ybytes.at[..., 31].add(sign << 7)
+
+
+def shamir_ladder(s_bits, h_bits, a_neg):
+    """[S]B + [h]*(-A) with one joint table lookup per bit.
+
+    s_bits, h_bits: (…,256) int32 LSB-first; a_neg: point with (…,16) coords.
+    """
+    shape = s_bits.shape[:-1]
+    b_pt = base_point(shape)
+    ident = identity(shape)
+    b_an = point_add(b_pt, a_neg)
+    # Table stacked on a new leading-of-last axis: (…, 4, 16) per coordinate.
+    table = tuple(
+        jnp.stack([ident[c], b_pt[c], a_neg[c], b_an[c]], axis=-2)
+        for c in range(4)
+    )
+
+    def body(i, acc):
+        bit = 255 - i
+        bs = lax.dynamic_index_in_dim(s_bits, bit, axis=-1, keepdims=False)
+        bh = lax.dynamic_index_in_dim(h_bits, bit, axis=-1, keepdims=False)
+        idx = (bs + 2 * bh).astype(jnp.int32)
+        sel = tuple(
+            jnp.take_along_axis(
+                table[c], idx[..., None, None].astype(jnp.int64), axis=-2
+            ).squeeze(-2)
+            for c in range(4)
+        )
+        acc = point_add(acc, acc)
+        return point_add(acc, sel)
+
+    return lax.fori_loop(0, 256, body, ident)
+
+
+def verify_kernel(pub, msg, sig):
+    """(…,32),(…,32),(…,64) uint8 -> (…,) bool. Batch-agnostic."""
+    pub = jnp.asarray(pub, jnp.uint8)
+    msg = jnp.asarray(msg, jnp.uint8)
+    sig = jnp.asarray(sig, jnp.uint8)
+    r_bytes = sig[..., :32]
+    s_bytes = sig[..., 32:]
+    # Challenge hash: h = SHA512(R || A || M) mod L.
+    h_raw = sha512(jnp.concatenate([r_bytes, pub, msg], axis=-1))
+    h = F.reduce512_mod_l(F.bytes_to_limbs(h_raw))
+    s = F.bytes_to_limbs(s_bytes)
+    s_ok = F.scalar_lt_l(s)
+    ok_a, a_pt = decompress(pub)
+    p = shamir_ladder(F.scalar_bits(s), F.scalar_bits(h), point_neg(a_pt))
+    enc = compress(p)
+    match = jnp.all(enc == r_bytes, axis=-1)
+    return ok_a & s_ok & match

@@ -1,0 +1,291 @@
+"""GF(2^255-19) and mod-L arithmetic in JAX, designed for vmap/XLA.
+
+Representation: field elements are (..., 16) int64 arrays of 16-bit limbs,
+little-endian (value = sum limb_i * 2^(16*i)). Limbs are *signed* and allowed
+to drift a few bits above 16 between operations ("loose" form); every multiply
+renormalizes. The signed-limb choice makes subtraction carry-free and the
+arithmetic right shift does borrow propagation for free.
+
+Bounds that make this sound (see ``mul``): with |limb| < 2^20 on both inputs,
+schoolbook columns are < 16 * 2^40 = 2^44 and the 38-fold (2^256 = 38 mod p)
+adds < 2^50 — far inside int64. Two carry passes return limbs to < 2^17.
+
+The mod-L half (group order L = 2^252 + delta) implements the 512-bit
+challenge-hash reduction with three positivity-preserving folds at the 2^252
+boundary: x = hi*2^252 + lo == lo - hi*delta + M_k*L (mod L) where M_k is a
+static per-iteration constant chosen so the result stays non-negative while
+still shrinking ~127 bits per fold.
+
+This is the arithmetic layer under pbft_tpu.crypto.ed25519; everything here
+is batch-agnostic (leading dims broadcast) and contains no data-dependent
+control flow, so it jits and vmaps cleanly onto TPU.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+P = 2**255 - 19
+L = 2**252 + 27742317777372353535851937790883648493
+DELTA = L - 2**252
+NLIMBS = 16
+MASK = 0xFFFF
+
+
+def limbs_const(v: int, n: int = NLIMBS) -> np.ndarray:
+    """Static Python int -> (n,) int64 limb array (16-bit, little-endian)."""
+    return np.array([(v >> (16 * i)) & MASK for i in range(n)], dtype=np.int64)
+
+
+def limbs_to_int(arr) -> int:
+    """(…,16) limbs -> Python int (tests/debug only; takes the last axis)."""
+    a = np.asarray(arr, dtype=object)
+    return int(sum(int(x) << (16 * i) for i, x in enumerate(a)))
+
+
+_P_LIMBS = limbs_const(P)
+_2P_LIMBS = limbs_const(2 * P)
+
+
+def zeros_like_field(x):
+    return jnp.zeros(x.shape, jnp.int64)
+
+
+def carry(x):
+    """One signed carry pass; wraps the 2^256 overflow back as *38 (mod p)."""
+    out = []
+    c = jnp.zeros_like(x[..., 0])
+    for i in range(NLIMBS):
+        v = x[..., i] + c
+        c = v >> 16
+        out.append(v & MASK)
+    r = jnp.stack(out, axis=-1)
+    return r.at[..., 0].add(38 * c)
+
+
+def add(a, b):
+    return carry(a + b)
+
+
+def sub(a, b):
+    return carry(a - b)
+
+
+def neg(a):
+    return carry(jnp.asarray(_2P_LIMBS) - a)
+
+
+def mul(a, b):
+    """Field multiply. Inputs: loose limbs |x| < 2^20. Output: limbs < 2^17."""
+    cols = jnp.zeros(jnp.broadcast_shapes(a.shape, b.shape)[:-1] + (31,), jnp.int64)
+    for i in range(NLIMBS):
+        cols = cols.at[..., i : i + NLIMBS].add(a[..., i : i + 1] * b)
+    lo = cols[..., :NLIMBS]
+    lo = lo.at[..., : NLIMBS - 1].add(38 * cols[..., NLIMBS:])
+    return carry(carry(lo))
+
+
+def sqr(a):
+    return mul(a, a)
+
+
+def mul_small(a, k: int):
+    """Multiply by a small static scalar (k < 2^20)."""
+    return carry(a * k)
+
+
+def _sqr_body(_, v):
+    return sqr(v)
+
+
+def pow2k(x, k: int):
+    """x^(2^k) by k squarings (static k; fori_loop body is a module-level
+    function so jax's trace cache hits across calls)."""
+    from jax import lax
+
+    if k <= 4:
+        for _ in range(k):
+            x = sqr(x)
+        return x
+    return lax.fori_loop(0, k, _sqr_body, x)
+
+
+def _inv_chain(z):
+    """Shared ladder: returns (z^(2^250-1), z^11, z^(2^50-1), z^(2^10-1), z2).
+
+    The classic curve25519 exponent chain; pieces are reused by both inv()
+    (exponent p-2 = 2^255-21) and pow_p58() (exponent (p-5)/8 = 2^252-3).
+    """
+    z2 = sqr(z)
+    z8 = pow2k(z2, 2)
+    z9 = mul(z, z8)
+    z11 = mul(z2, z9)
+    z22 = sqr(z11)
+    z_5_0 = mul(z9, z22)  # 2^5 - 1
+    z_10_0 = mul(pow2k(z_5_0, 5), z_5_0)  # 2^10 - 1
+    z_20_0 = mul(pow2k(z_10_0, 10), z_10_0)  # 2^20 - 1
+    z_40_0 = mul(pow2k(z_20_0, 20), z_20_0)  # 2^40 - 1
+    z_50_0 = mul(pow2k(z_40_0, 10), z_10_0)  # 2^50 - 1
+    z_100_0 = mul(pow2k(z_50_0, 50), z_50_0)  # 2^100 - 1
+    z_200_0 = mul(pow2k(z_100_0, 100), z_100_0)  # 2^200 - 1
+    z_250_0 = mul(pow2k(z_200_0, 50), z_50_0)  # 2^250 - 1
+    return z_250_0, z11
+
+
+def inv(z):
+    """z^(p-2) = z^(2^255-21): the field inverse (inv(0) = 0)."""
+    z_250_0, z11 = _inv_chain(z)
+    return mul(pow2k(z_250_0, 5), z11)
+
+
+def pow_p58(z):
+    """z^((p-5)/8) = z^(2^252-3), used for the square-root-ratio."""
+    z_250_0, _ = _inv_chain(z)
+    return mul(pow2k(z_250_0, 2), z)
+
+
+def canon(x):
+    """Canonical form: limbs in [0, 2^16), value in [0, p)."""
+    x = carry(carry(x))
+    # Force non-negativity: add 2p (== 0 mod p); the value may have been a
+    # small negative after signed folds.
+    x = carry(x + jnp.asarray(_2P_LIMBS))
+    # Fold bit 255+: value < 2^256 -> < 2^255 + 38.
+    hi = x[..., NLIMBS - 1] >> 15
+    x = x.at[..., NLIMBS - 1].add(-(hi << 15))
+    x = x.at[..., 0].add(19 * hi)
+    x = carry(x)
+    # At most two conditional subtracts of p remain.
+    for _ in range(2):
+        b = jnp.zeros_like(x[..., 0])
+        digits = []
+        for i in range(NLIMBS):
+            v = x[..., i] - jnp.asarray(_P_LIMBS)[i] + b
+            digits.append(v & MASK)
+            b = v >> 16
+        y = jnp.stack(digits, axis=-1)
+        ge = b == 0  # no final borrow -> x >= p
+        x = jnp.where(ge[..., None], y, x)
+    return x
+
+
+def eq(a, b):
+    return jnp.all(canon(a) == canon(b), axis=-1)
+
+
+def is_zero(a):
+    return jnp.all(canon(a) == 0, axis=-1)
+
+
+def bytes_to_limbs(b):
+    """(…,2n) uint8 little-endian -> (…,n) int64 limbs (32 bytes -> 16 limbs,
+    64-byte digests -> 32 limbs)."""
+    b = jnp.asarray(b, jnp.int64)
+    pairs = b.reshape(b.shape[:-1] + (b.shape[-1] // 2, 2))
+    return pairs[..., 0] + (pairs[..., 1] << 8)
+
+
+def limbs_to_bytes(x):
+    """Canonical limbs -> (…,32) uint8 little-endian."""
+    x = canon(x)
+    lo = (x & 0xFF).astype(jnp.uint8)
+    hi = ((x >> 8) & 0xFF).astype(jnp.uint8)
+    return jnp.stack([lo, hi], axis=-1).reshape(x.shape[:-1] + (32,))
+
+
+# ---------------------------------------------------------------------------
+# Scalar arithmetic mod L (group order), for the challenge hash and S check.
+# ---------------------------------------------------------------------------
+
+_L_LIMBS = limbs_const(L)
+
+
+def _plain_carry(x, n: int):
+    """Carry pass without any modular fold (plain multi-precision integer)."""
+    out = []
+    c = jnp.zeros_like(x[..., 0])
+    for i in range(n):
+        v = x[..., i] + c
+        c = v >> 16
+        out.append(v & MASK)
+    out[-1] = out[-1] + (c << 16)  # keep any residue in the top limb
+    return jnp.stack(out, axis=-1)
+
+
+def _mul_by_const(x, nx: int, const_limbs: np.ndarray, nout: int):
+    """Multi-precision multiply of x (nx limbs) by a static constant."""
+    k = len(const_limbs)
+    cols = jnp.zeros(x.shape[:-1] + (nout,), jnp.int64)
+    for i in range(k):
+        ci = int(const_limbs[i])
+        if ci == 0:
+            continue
+        hi = min(nx, nout - i)
+        cols = cols.at[..., i : i + hi].add(ci * x[..., :hi])
+    return cols
+
+
+_FOLD_M: list[np.ndarray] = []
+
+
+def _build_fold_constants():
+    """Static M_k*L addends keeping each 2^252-fold non-negative.
+
+    After normalizing to S_k bits, hi < 2^(S_k-252) so hi*delta <
+    2^(S_k-252)*2^125. Pick M_k = ceil(2^(S_k-127)/L)+1; then
+    lo - hi*delta + M_k*L is in [0, 2^252 + (M_k+1)*L)."""
+    sizes = [512, 390, 266]
+    for s in sizes:
+        m = (1 << max(s - 127, 0)) // L + 2
+        _FOLD_M.append(limbs_const(m * L, 33))
+
+
+_build_fold_constants()
+_DELTA_LIMBS = limbs_const(DELTA, 8)
+
+
+def reduce512_mod_l(x):
+    """(…,32) limbs (512-bit LE integer) -> (…,16) limbs in [0, L)."""
+    x = jnp.concatenate([x, jnp.zeros(x.shape[:-1] + (1,), jnp.int64)], axis=-1)
+    x = _plain_carry(x, 33)
+    for m_l in _FOLD_M:
+        # hi = x >> 252; limb 15 keeps its low 12 bits.
+        hi = ((x[..., 15:32] >> 12) | ((x[..., 16:33] & 0xFFF) << 4))
+        lo = x.at[..., 15].set(x[..., 15] & 0xFFF)
+        lo = lo.at[..., 16:].set(0)
+        prod = _mul_by_const(hi, 17, _DELTA_LIMBS, 25)
+        prod = jnp.concatenate(
+            [prod, jnp.zeros(prod.shape[:-1] + (8,), jnp.int64)], axis=-1
+        )
+        x = lo - prod + jnp.asarray(m_l)
+        x = _plain_carry(x, 33)
+    # Value now < 2^254-ish: at most 3 conditional subtracts of L.
+    x = x[..., :NLIMBS + 1]
+    l_ext = np.concatenate([_L_LIMBS, np.zeros(1, np.int64)])
+    for _ in range(4):
+        b = jnp.zeros_like(x[..., 0])
+        digits = []
+        for i in range(NLIMBS + 1):
+            v = x[..., i] - jnp.asarray(l_ext)[i] + b
+            digits.append(v & MASK)
+            b = v >> 16
+        y = jnp.stack(digits, axis=-1)
+        x = jnp.where((b == 0)[..., None], y, x)
+    return x[..., :NLIMBS]
+
+
+def scalar_lt_l(s):
+    """(…,16) limbs -> bool: is the 256-bit scalar strictly below L?"""
+    b = jnp.zeros_like(s[..., 0])
+    for i in range(NLIMBS):
+        v = s[..., i] - jnp.asarray(_L_LIMBS)[i] + b
+        b = v >> 16
+    return b < 0
+
+
+def scalar_bits(s, nbits: int = 256):
+    """(…,16) limbs -> (…, nbits) int32 bit array, LSB first."""
+    shifts = jnp.arange(16, dtype=jnp.int64)
+    bits = (s[..., :, None] >> shifts) & 1
+    return bits.reshape(s.shape[:-1] + (NLIMBS * 16,))[..., :nbits].astype(jnp.int32)

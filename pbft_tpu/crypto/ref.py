@@ -82,14 +82,34 @@ def point_add(a: Tuple[int, int], b: Tuple[int, int]) -> Tuple[int, int]:
     return x3 % P, y3 % P
 
 
+_D2 = 2 * D % P
+
+
+def _ext_add(p, q):
+    """Complete unified addition in extended coordinates (a=-1); avoids the
+    per-addition inversions of the affine form — this is the host signer's
+    hot loop."""
+    x1, y1, z1, t1 = p
+    x2, y2, z2, t2 = q
+    a = (y1 - x1) * (y2 - x2) % P
+    b = (y1 + x1) * (y2 + x2) % P
+    c = t1 * _D2 % P * t2 % P
+    d = 2 * z1 * z2 % P
+    e, f, g, h = b - a, d - c, d + c, b + a
+    return (e * f % P, g * h % P, f * g % P, e * h % P)
+
+
 def scalar_mult(k: int, pt: Tuple[int, int]) -> Tuple[int, int]:
-    acc = (0, 1)
+    acc = (0, 1, 1, 0)
+    cur = (pt[0], pt[1], 1, pt[0] * pt[1] % P)
     while k:
         if k & 1:
-            acc = point_add(acc, pt)
-        pt = point_add(pt, pt)
+            acc = _ext_add(acc, cur)
+        cur = _ext_add(cur, cur)
         k >>= 1
-    return acc
+    x, y, z, _ = acc
+    zi = pow(z, P - 2, P)
+    return x * zi % P, y * zi % P
 
 
 def point_compress(pt: Tuple[int, int]) -> bytes:

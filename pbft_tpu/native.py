@@ -1,0 +1,97 @@
+"""ctypes bindings to the C++ core (core/ -> libpbftcore.so).
+
+The native library provides the CPU verifier backend (the control arm of the
+CPU-vs-TPU A/B) plus Blake2b/SHA-512/Ed25519 primitives, all equivalence-
+tested against the Python oracle and the JAX kernels. pybind11 is not in this
+environment; the C ABI in core/capi.cc is the binding surface.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from pathlib import Path
+from typing import List, Optional, Sequence, Tuple
+
+_REPO_ROOT = Path(__file__).resolve().parent.parent
+_BUILD_DIR = _REPO_ROOT / "build-core"
+_LIB_PATH = _BUILD_DIR / "libpbftcore.so"
+
+_lib: Optional[ctypes.CDLL] = None
+
+
+def build(force: bool = False) -> Path:
+    """Build the native core with cmake+ninja (idempotent)."""
+    if _LIB_PATH.exists() and not force:
+        return _LIB_PATH
+    subprocess.run(
+        ["cmake", "-S", str(_REPO_ROOT / "core"), "-B", str(_BUILD_DIR), "-G", "Ninja"],
+        check=True,
+        capture_output=True,
+    )
+    subprocess.run(
+        ["cmake", "--build", str(_BUILD_DIR)], check=True, capture_output=True
+    )
+    return _LIB_PATH
+
+
+def lib() -> ctypes.CDLL:
+    global _lib
+    if _lib is None:
+        path = build()
+        _lib = ctypes.CDLL(str(path))
+        _lib.pbft_ed25519_verify.restype = ctypes.c_int
+    return _lib
+
+
+def available() -> bool:
+    try:
+        lib()
+        return True
+    except Exception:
+        return False
+
+
+def blake2b(data: bytes, digest_size: int = 32) -> bytes:
+    out = ctypes.create_string_buffer(digest_size)
+    lib().pbft_blake2b(out, digest_size, data, len(data))
+    return out.raw
+
+
+def sha512(data: bytes) -> bytes:
+    out = ctypes.create_string_buffer(64)
+    lib().pbft_sha512(out, data, len(data))
+    return out.raw
+
+
+def public_key(seed: bytes) -> bytes:
+    out = ctypes.create_string_buffer(32)
+    lib().pbft_ed25519_public_key(out, seed)
+    return out.raw
+
+
+def sign(seed: bytes, msg: bytes) -> bytes:
+    out = ctypes.create_string_buffer(64)
+    lib().pbft_ed25519_sign(out, seed, msg, len(msg))
+    return out.raw
+
+
+def verify(pub: bytes, msg: bytes, sig: bytes) -> bool:
+    if len(pub) != 32 or len(sig) != 64:
+        return False
+    return bool(lib().pbft_ed25519_verify(pub, msg, len(msg), sig))
+
+
+def verify_batch(items: Sequence[Tuple[bytes, bytes, bytes]]) -> List[bool]:
+    """Native batch verify over (pub32, msg32, sig64) triples — the CPU
+    control arm with the same call shape as crypto.batch.verify_many."""
+    n = len(items)
+    if n == 0:
+        return []
+    pubs = b"".join(i[0] for i in items)
+    msgs = b"".join(i[1] for i in items)
+    sigs = b"".join(i[2] for i in items)
+    out = ctypes.create_string_buffer(n)
+    lib().pbft_ed25519_verify_batch(pubs, msgs, sigs, out, n)
+    return [b == 1 for b in out.raw]

@@ -1,16 +1,38 @@
-"""Test configuration.
+"""Test configuration: force pure-CPU JAX with 8 virtual devices.
 
-Tests run on CPU with 8 virtual XLA devices so that the multi-chip sharding
-path (pbft_tpu.parallel) is exercised without TPU hardware, mirroring how the
-driver dry-runs `__graft_entry__.dryrun_multichip`. Must be set before jax
-initializes its backends.
+Two subtleties of this environment:
+
+1. A sitecustomize hook registers the TPU PJRT plugin at interpreter startup
+   (before conftest runs) whenever the TPU pool env vars are set, and jax
+   initializes registered plugin backends even when jax_platforms=cpu.
+   Initializing the TPU client here would serialize every test process
+   through the single TPU tunnel (and wedge if another process holds it), so
+   tests must drop the plugin factory before the first backend init.
+2. The virtual 8-device CPU mesh (for the multi-chip sharding tests,
+   mirroring the driver's dryrun of __graft_entry__.dryrun_multichip) needs
+   XLA_FLAGS before backend init too.
+
+The TPU path itself is exercised by bench.py / __graft_entry__.py, not by
+unit tests.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+try:  # drop non-cpu plugin factories registered before conftest ran
+    from jax._src import xla_bridge
+
+    for _name in list(getattr(xla_bridge, "_backend_factories", {})):
+        if _name != "cpu":
+            xla_bridge._backend_factories.pop(_name)
+except Exception:  # pragma: no cover - jax internals may move
+    pass

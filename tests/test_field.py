@@ -1,0 +1,92 @@
+"""Property tests for the JAX GF(2^255-19) / mod-L limb arithmetic, checked
+against Python big-int ground truth."""
+
+import secrets
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from pbft_tpu.crypto import field as F
+
+
+def rand_fe():
+    return secrets.randbelow(F.P)
+
+
+def to_jax(v: int):
+    return jnp.asarray(F.limbs_const(v))
+
+
+def from_jax(x) -> int:
+    return F.limbs_to_int(np.asarray(F.canon(x)))
+
+
+@pytest.mark.parametrize("trial", range(5))
+def test_mul_add_sub_vs_bigint(trial):
+    a, b = rand_fe(), rand_fe()
+    ja, jb = to_jax(a), to_jax(b)
+    assert from_jax(F.mul(ja, jb)) == a * b % F.P
+    assert from_jax(F.add(ja, jb)) == (a + b) % F.P
+    assert from_jax(F.sub(ja, jb)) == (a - b) % F.P
+    assert from_jax(F.neg(ja)) == (-a) % F.P
+
+
+def test_edge_values():
+    for v in [0, 1, 2, 19, F.P - 1, F.P - 19, 2**255 - 20]:
+        assert from_jax(to_jax(v)) == v % F.P
+    # deep subtraction chains stay correct (signed-limb soundness)
+    x = to_jax(0)
+    for k in range(20):
+        x = F.sub(x, to_jax(F.P - 3 - k))
+    expected = sum(3 + k for k in range(20)) % F.P
+    assert from_jax(x) == expected
+
+
+def test_inv_and_pow():
+    for _ in range(3):
+        a = rand_fe() or 1
+        ja = to_jax(a)
+        assert from_jax(F.mul(ja, F.inv(ja))) == 1
+        assert from_jax(F.pow_p58(ja)) == pow(a, (F.P - 5) // 8, F.P)
+    assert from_jax(F.inv(to_jax(0))) == 0
+
+
+def test_batched_ops():
+    vals = [(rand_fe(), rand_fe()) for _ in range(6)]
+    ja = jnp.stack([to_jax(a) for a, _ in vals])
+    jb = jnp.stack([to_jax(b) for _, b in vals])
+    got = np.asarray(F.canon(F.mul(ja, jb)))
+    for row, (a, b) in zip(got, vals):
+        assert F.limbs_to_int(row) == a * b % F.P
+
+
+def test_bytes_roundtrip():
+    v = rand_fe()
+    raw = np.frombuffer(int.to_bytes(v, 32, "little"), np.uint8)
+    limbs = F.bytes_to_limbs(jnp.asarray(raw))
+    assert from_jax(limbs) == v
+    back = np.asarray(F.limbs_to_bytes(limbs))
+    assert bytes(back) == int.to_bytes(v, 32, "little")
+
+
+def test_reduce512_mod_l():
+    cases = [0, 1, F.L - 1, F.L, F.L + 1, 2**252, 2**512 - 1]
+    cases += [secrets.randbelow(2**512) for _ in range(6)]
+    for v in cases:
+        raw = np.frombuffer(int.to_bytes(v, 64, "little"), np.uint8)
+        limbs32 = F.bytes_to_limbs(jnp.asarray(raw))
+        got = F.limbs_to_int(np.asarray(F.reduce512_mod_l(limbs32)))
+        assert got == v % F.L, f"failed for {v:#x}"
+
+
+def test_scalar_lt_l():
+    for v, want in [(0, True), (F.L - 1, True), (F.L, False), (2**256 - 1, False)]:
+        assert bool(F.scalar_lt_l(to_jax(v))) == want
+
+
+def test_scalar_bits():
+    v = secrets.randbelow(2**256)
+    bits = np.asarray(F.scalar_bits(jnp.asarray(F.limbs_const(v))))
+    for k in range(256):
+        assert bits[k] == (v >> k) & 1

@@ -1,0 +1,82 @@
+"""Equivalence: C++ core crypto (via ctypes) vs hashlib and the Python
+oracle — SURVEY.md §4 item 3, native edition."""
+
+import hashlib
+import secrets
+
+import pytest
+
+from pbft_tpu import native
+from pbft_tpu.crypto import ref
+from tests.test_crypto_ref import RFC8032_VECTORS
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native core not buildable"
+)
+
+
+@pytest.mark.parametrize("n", [0, 1, 64, 111, 128, 129, 300, 1000])
+def test_blake2b_matches_hashlib(n):
+    data = secrets.token_bytes(n)
+    assert native.blake2b(data) == hashlib.blake2b(data, digest_size=32).digest()
+    assert native.blake2b(data, 64) == hashlib.blake2b(data).digest()
+
+
+@pytest.mark.parametrize("n", [0, 1, 95, 96, 111, 112, 127, 128, 129, 300])
+def test_sha512_matches_hashlib(n):
+    data = secrets.token_bytes(n)
+    assert native.sha512(data) == hashlib.sha512(data).digest()
+
+
+@pytest.mark.parametrize("seed,pub,msg,sig", RFC8032_VECTORS)
+def test_rfc8032(seed, pub, msg, sig):
+    seed, pub, msg, sig = (bytes.fromhex(x) for x in (seed, pub, msg, sig))
+    assert native.public_key(seed) == pub
+    assert native.sign(seed, msg) == sig
+    assert native.verify(pub, msg, sig)
+    assert not native.verify(pub, msg + b"x", sig)
+
+
+def test_native_vs_oracle_random():
+    for i in range(6):
+        seed, pub = ref.keygen()
+        msg = secrets.token_bytes(32)
+        assert native.public_key(seed) == pub
+        sig_native = native.sign(seed, msg)
+        assert sig_native == ref.sign(seed, msg)
+        assert native.verify(pub, msg, sig_native)
+        bad = bytes([sig_native[0] ^ 1]) + sig_native[1:]
+        assert not native.verify(pub, msg, bad)
+        assert native.verify(pub, msg, sig_native) == ref.verify(pub, msg, sig_native)
+
+
+def test_native_rejects_malleated_s():
+    seed, pub = ref.keygen()
+    msg = secrets.token_bytes(32)
+    sig = ref.sign(seed, msg)
+    s = int.from_bytes(sig[32:], "little")
+    mall = sig[:32] + int.to_bytes(s + ref.L, 32, "little")
+    assert not native.verify(pub, msg, mall)
+
+
+def test_native_rejects_bad_pubkeys():
+    msg = secrets.token_bytes(32)
+    sig = bytes(64)
+    noncanon = int.to_bytes(ref.P, 32, "little")
+    assert not native.verify(noncanon, msg, sig)
+    assert not native.verify(int.to_bytes(2, 32, "little"), msg, sig) or \
+        ref.point_decompress(int.to_bytes(2, 32, "little")) is not None
+
+
+def test_native_batch():
+    items, want = [], []
+    for i in range(7):
+        seed, pub = ref.keygen()
+        msg = secrets.token_bytes(32)
+        sig = ref.sign(seed, msg)
+        if i % 3 == 0:
+            sig = sig[:33] + bytes([sig[33] ^ 0x80]) + sig[34:]
+        items.append((pub, msg, sig))
+        want.append(i % 3 != 0)
+    assert native.verify_batch(items) == want
+    assert native.verify_batch([]) == []

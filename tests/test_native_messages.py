@@ -1,0 +1,61 @@
+"""FFI determinism: C++ canonical message encoding == Python's, byte for byte
+(SURVEY.md §7 — verifier results and digests must be identical across
+backends, or replicas diverge)."""
+
+import ctypes
+
+import pytest
+
+from pbft_tpu import native
+from pbft_tpu.consensus.messages import (
+    Checkpoint,
+    ClientReply,
+    ClientRequest,
+    Commit,
+    Prepare,
+    PrePrepare,
+)
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native core not buildable"
+)
+
+
+def cxx_roundtrip(payload: bytes):
+    lib = native.lib()
+    lib.pbft_message_roundtrip.restype = ctypes.c_size_t
+    buf = ctypes.create_string_buffer(len(payload) * 4 + 64)
+    dig = ctypes.create_string_buffer(32)
+    n = lib.pbft_message_roundtrip(payload, len(payload), buf, len(buf), dig)
+    return buf.raw[:n], dig.raw
+
+
+REQ = ClientRequest(
+    operation='héllo ☃ "q" \\s\n\t\x01 \U0001f600', timestamp=1 << 40,
+    client="127.0.0.1:9000",
+)
+MESSAGES = [
+    REQ,
+    ClientReply(view=0, timestamp=1, client="c:1", replica=3, result="awesome!"),
+    PrePrepare(view=0, seq=7, digest=REQ.digest(), request=REQ, replica=0, sig="ab" * 64),
+    Prepare(view=1, seq=2, digest="dd" * 32, replica=2, sig="cd" * 64),
+    Commit(view=1, seq=2, digest="dd" * 32, replica=2, sig="ef" * 64),
+    Checkpoint(seq=16, digest="11" * 32, replica=1, sig="22" * 64),
+]
+
+
+@pytest.mark.parametrize("msg", MESSAGES, ids=lambda m: type(m).__name__)
+def test_canonical_and_signable_identical(msg):
+    payload = msg.canonical()
+    cxx_canon, cxx_digest = cxx_roundtrip(payload)
+    assert cxx_canon == payload
+    assert cxx_digest == msg.signable()
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [b"", b"{", b'{"type":"nope"}', b'{"type":"prepare"}', b"\xff\xfe garbage"],
+)
+def test_malformed_payload_rejected(bad):
+    canon, _ = cxx_roundtrip(bad)
+    assert canon == b""

@@ -1,0 +1,35 @@
+"""Known-answer tests: JAX SHA-512 vs hashlib."""
+
+import hashlib
+import secrets
+
+import numpy as np
+import pytest
+
+from pbft_tpu.crypto.sha512 import sha512
+
+
+@pytest.mark.parametrize("n", [0, 1, 3, 55, 95, 96, 111, 112, 127, 128, 129, 200, 256])
+def test_sha512_matches_hashlib(n):
+    msg = secrets.token_bytes(n)
+    got = bytes(np.asarray(sha512(np.frombuffer(msg, np.uint8))))
+    assert got == hashlib.sha512(msg).digest()
+
+
+def test_sha512_batched():
+    batch = np.stack(
+        [np.frombuffer(secrets.token_bytes(96), np.uint8) for _ in range(7)]
+    )
+    got = np.asarray(sha512(batch))
+    for row, exp in zip(got, batch):
+        assert bytes(row) == hashlib.sha512(bytes(exp)).digest()
+
+
+def test_sha512_abc():
+    got = bytes(np.asarray(sha512(np.frombuffer(b"abc", np.uint8))))
+    assert got == hashlib.sha512(b"abc").digest()
+    assert (
+        got.hex()
+        == "ddaf35a193617abacc417349ae20413112e6fa4e89a97ea20a9eeee64b55d39a"
+        "2192992a274fc1a836ba3c23a3feebbd454d4423643ce80e2a9ac94fa54ca49f"
+    )
