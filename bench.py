@@ -1,0 +1,121 @@
+"""Benchmark: PREPARE+COMMIT signature verifications/sec on one chip.
+
+The north-star metric (BASELINE.json): the reference intended per-message
+Ed25519 checks on every PREPARE/COMMIT (left as TODOs, reference
+src/behavior.rs:127,:185); this framework batches a window of quorum
+certificates into one XLA launch. The bench drives the batched JAX verifier
+with realistic consensus traffic shapes (32-byte signed digests, mixed
+valid/invalid) and reports sustained verifications/sec.
+
+Baseline for vs_baseline: the reference publishes no numbers and does not
+compile (SURVEY.md §6); BASELINE.json's target is >= 50,000 verifies/sec on
+one TPU host, so vs_baseline = value / 50_000.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def _log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main() -> None:
+    if os.environ.get("PBFT_BENCH_CPU") or os.environ.get("JAX_PLATFORMS") == "cpu":
+        # CPU smoke-test mode: keep the TPU PJRT plugin (registered by the
+        # environment at interpreter startup) from initializing.
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax as _jax
+
+        _jax.config.update("jax_platforms", "cpu")
+        try:
+            from jax._src import xla_bridge
+
+            for name in list(getattr(xla_bridge, "_backend_factories", {})):
+                if name != "cpu":
+                    xla_bridge._backend_factories.pop(name)
+        except Exception:
+            pass
+    import pbft_tpu  # noqa: F401  (enables x64 before jax init)
+    import jax
+
+    from pbft_tpu.crypto import ref
+    from pbft_tpu.crypto.batch import verify_batch
+
+    batch = int(os.environ.get("PBFT_BENCH_BATCH", "4096"))
+    target_secs = float(os.environ.get("PBFT_BENCH_SECS", "5.0"))
+    _log(f"devices: {jax.devices()}; batch={batch}")
+
+    # Build a pool of unique signed triples and tile to the batch size
+    # (verification cost is independent of uniqueness; signing in the pure
+    # Python oracle is slow, so keep the pool small — or use the native
+    # C++ signer when the toolchain has built it).
+    pool = 64
+    pubs = np.zeros((pool, 32), np.uint8)
+    msgs = np.zeros((pool, 32), np.uint8)
+    sigs = np.zeros((pool, 64), np.uint8)
+    signer_pub = None
+    signer_sign = None
+    try:
+        from pbft_tpu import native
+
+        if native.available():
+            signer_pub, signer_sign = native.public_key, native.sign
+            _log("signer: native C++ core")
+    except Exception as e:  # pragma: no cover
+        _log(f"native core unavailable ({e}); using Python oracle signer")
+    if signer_pub is None:
+        signer_pub, signer_sign = ref.public_key, ref.sign
+    for i in range(pool):
+        seed = bytes([i + 1, 0x42]) * 16
+        msg = os.urandom(32)
+        pubs[i] = np.frombuffer(signer_pub(seed), np.uint8)
+        msgs[i] = np.frombuffer(msg, np.uint8)
+        sigs[i] = np.frombuffer(signer_sign(seed, msg), np.uint8)
+    reps = (batch + pool - 1) // pool
+    bp = np.tile(pubs, (reps, 1))[:batch]
+    bm = np.tile(msgs, (reps, 1))[:batch]
+    bs = np.tile(sigs, (reps, 1))[:batch]
+    # Corrupt one signature: the batch-reject path must not cost extra.
+    bs[batch // 2, 7] ^= 0xFF
+
+    t0 = time.perf_counter()
+    out = np.asarray(jax.block_until_ready(verify_batch(bp, bm, bs)))
+    compile_s = time.perf_counter() - t0
+    assert out.sum() == batch - 1, "verifier verdicts wrong"
+    assert not out[batch // 2], "corrupted signature not rejected"
+    _log(f"compile+first batch: {compile_s:.1f}s; verdicts OK")
+
+    # Timed region: steady-state batches.
+    iters = 0
+    t0 = time.perf_counter()
+    elapsed = 0.0
+    while elapsed < target_secs:
+        jax.block_until_ready(verify_batch(bp, bm, bs))
+        iters += 1
+        elapsed = time.perf_counter() - t0
+    per_sec = iters * batch / elapsed
+    _log(f"{iters} batches of {batch} in {elapsed:.2f}s")
+
+    print(
+        json.dumps(
+            {
+                "metric": "ed25519_sig_verifies_per_sec",
+                "value": round(per_sec, 1),
+                "unit": "signatures/sec",
+                "vs_baseline": round(per_sec / 50_000.0, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

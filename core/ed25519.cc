@@ -1,6 +1,7 @@
 #include "ed25519.h"
 
 #include <cstring>
+#include <vector>
 
 #include "sha512.h"
 
@@ -377,15 +378,14 @@ void expand_seed(u64 a_sc[4], uint8_t prefix[32], const uint8_t seed[32]) {
 void hash_to_scalar(u64 out[4], const uint8_t* p1, const uint8_t* p2,
                     const uint8_t* p3, size_t n3) {
   // SHA512(p1 || p2 || p3) mod L, p1/p2 32 bytes each (or p2 null).
-  uint8_t buf[64 + 4096];
-  size_t off = 0;
-  std::memcpy(buf + off, p1, 32); off += 32;
-  if (p2) { std::memcpy(buf + off, p2, 32); off += 32; }
-  // long messages hashed in streaming fashion would be better; PBFT signs
-  // 32-byte digests so n3 is tiny.
-  std::memcpy(buf + off, p3, n3); off += n3;
+  // The message length is caller-controlled (public C ABI) — heap buffer.
+  std::vector<uint8_t> buf;
+  buf.reserve(64 + n3);
+  buf.insert(buf.end(), p1, p1 + 32);
+  if (p2) buf.insert(buf.end(), p2, p2 + 32);
+  buf.insert(buf.end(), p3, p3 + n3);
   uint8_t h[64];
-  sha512(h, buf, off);
+  sha512(h, buf.data(), buf.size());
   u64 wide[8];
   std::memcpy(wide, h, 64);
   sc_reduce512(out, wide);

@@ -1,6 +1,8 @@
 #include "json.h"
 
+#include <cerrno>
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
 
 namespace pbft {
@@ -38,7 +40,10 @@ void escape_string(const std::string& s, std::string* out) {
       out->append("\\b"); ++i;
     } else if (c == '\f') {
       out->append("\\f"); ++i;
-    } else if (c < 0x20) {
+    } else if (c < 0x80) {
+      // Control chars and 0x7F (DEL): \u00XX, exactly like CPython's
+      // ensure_ascii serializer (0x7F must NOT enter the UTF-8 decoder —
+      // digests are computed over these bytes on both sides).
       emit_u16(c);
       ++i;
     } else {
@@ -224,7 +229,7 @@ struct Parser {
 
   bool parse_number(Json* out) {
     const char* start = p;
-    if (p < end && (*p == '-' || *p == '+')) ++p;
+    if (p < end && *p == '-') ++p;  // ('+' is not valid JSON)
     bool is_double = false;
     while (p < end && ((*p >= '0' && *p <= '9') || *p == '.' || *p == 'e' ||
                        *p == 'E' || *p == '-' || *p == '+')) {
@@ -236,7 +241,16 @@ struct Parser {
     if (is_double) {
       *out = Json(std::strtod(tok.c_str(), nullptr));
     } else {
-      *out = Json((int64_t)std::strtoll(tok.c_str(), nullptr, 10));
+      // Reject integers outside int64 instead of silently saturating:
+      // Python parses arbitrary precision, so saturation would make the
+      // two implementations digest *different* canonical bytes for the
+      // same wire message (a consensus divergence). Out-of-range ->
+      // parse failure -> the message is dropped on both sides (the
+      // Python side enforces the same bound in from_wire).
+      errno = 0;
+      long long v = std::strtoll(tok.c_str(), nullptr, 10);
+      if (errno == ERANGE) return false;
+      *out = Json((int64_t)v);
     }
     return true;
   }

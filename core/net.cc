@@ -1,0 +1,313 @@
+#include "net.h"
+
+#include <arpa/inet.h>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <cerrno>
+#include <cstdio>
+#include <cstring>
+
+namespace pbft {
+
+namespace {
+
+void set_nonblocking(int fd) {
+  int flags = fcntl(fd, F_GETFL, 0);
+  fcntl(fd, F_SETFL, flags | O_NONBLOCK);
+}
+
+bool split_host_port(const std::string& hp, std::string* host, int* port) {
+  auto pos = hp.rfind(':');
+  if (pos == std::string::npos) return false;
+  *host = hp.substr(0, pos);
+  *port = std::atoi(hp.c_str() + pos + 1);
+  return *port > 0;
+}
+
+}  // namespace
+
+int dial_tcp(const std::string& host_port) {
+  std::string host;
+  int port;
+  if (!split_host_port(host_port, &host, &port)) return -1;
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons((uint16_t)port);
+  if (inet_pton(AF_INET, host.c_str(), &addr.sin_addr) != 1) {
+    close(fd);
+    return -1;
+  }
+  if (connect(fd, (sockaddr*)&addr, sizeof(addr)) != 0) {
+    close(fd);
+    return -1;
+  }
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  return fd;
+}
+
+ReplicaServer::ReplicaServer(ClusterConfig cfg, int64_t id,
+                             const uint8_t seed[32],
+                             std::unique_ptr<Verifier> verifier)
+    : cfg_(cfg), id_(id), verifier_(std::move(verifier)) {
+  replica_ = std::make_unique<Replica>(cfg_, id_, seed);
+}
+
+ReplicaServer::~ReplicaServer() {
+  if (listen_fd_ >= 0) close(listen_fd_);
+  for (auto& c : conns_)
+    if (c->fd >= 0) close(c->fd);
+  for (auto& [_, c] : peers_)
+    if (c->fd >= 0) close(c->fd);
+}
+
+bool ReplicaServer::start() {
+  listen_fd_ = socket(AF_INET, SOCK_STREAM, 0);
+  if (listen_fd_ < 0) return false;
+  int one = 1;
+  setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons((uint16_t)cfg_.replicas[id_].port);
+  if (bind(listen_fd_, (sockaddr*)&addr, sizeof(addr)) != 0) return false;
+  if (listen(listen_fd_, 128) != 0) return false;
+  socklen_t len = sizeof(addr);
+  getsockname(listen_fd_, (sockaddr*)&addr, &len);
+  listen_port_ = ntohs(addr.sin_port);
+  set_nonblocking(listen_fd_);
+  return true;
+}
+
+void ReplicaServer::run() {
+  while (!stopping_) poll_once(100);
+}
+
+void ReplicaServer::poll_once(int timeout_ms) {
+  std::vector<pollfd> pfds;
+  pfds.push_back({listen_fd_, POLLIN, 0});
+  std::vector<Conn*> order;
+  for (auto& c : conns_) {
+    short ev = POLLIN;
+    if (!c->wbuf.empty()) ev |= POLLOUT;
+    pfds.push_back({c->fd, ev, 0});
+    order.push_back(c.get());
+  }
+  for (auto& [_, c] : peers_) {
+    if (!c->wbuf.empty()) {
+      pfds.push_back({c->fd, POLLOUT, 0});
+      order.push_back(c.get());
+    }
+  }
+  int n = ::poll(pfds.data(), pfds.size(), timeout_ms);
+  if (n < 0) return;
+  if (pfds[0].revents & POLLIN) accept_ready();
+  for (size_t i = 1; i < pfds.size(); ++i) {
+    Conn* c = order[i - 1];
+    if (pfds[i].revents & (POLLIN | POLLHUP | POLLERR)) handle_readable(*c);
+    if ((pfds[i].revents & POLLOUT) && !c->closed) flush(*c);
+  }
+  // The batching window: everything that arrived this iteration verifies
+  // as one batch (one XLA launch on the TPU backend).
+  run_verify_batch();
+  // Drop closed inbound connections.
+  conns_.erase(
+      std::remove_if(conns_.begin(), conns_.end(),
+                     [](const std::unique_ptr<Conn>& c) { return c->closed; }),
+      conns_.end());
+  for (auto it = peers_.begin(); it != peers_.end();) {
+    if (it->second->closed) {
+      it = peers_.erase(it);
+    } else {
+      ++it;
+    }
+  }
+}
+
+void ReplicaServer::accept_ready() {
+  for (;;) {
+    int fd = accept(listen_fd_, nullptr, nullptr);
+    if (fd < 0) return;
+    set_nonblocking(fd);
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    auto c = std::make_unique<Conn>();
+    c->fd = fd;
+    conns_.push_back(std::move(c));
+  }
+}
+
+void ReplicaServer::handle_readable(Conn& c) {
+  char buf[65536];
+  for (;;) {
+    ssize_t r = read(c.fd, buf, sizeof(buf));
+    if (r > 0) {
+      c.rbuf.append(buf, (size_t)r);
+      continue;
+    }
+    if (r < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) break;
+    // EOF or error: a raw-JSON client may terminate its message by close.
+    if (!c.rbuf.empty()) process_buffer(c);
+    close(c.fd);
+    c.closed = true;
+    return;
+  }
+  process_buffer(c);
+}
+
+void ReplicaServer::process_buffer(Conn& c) {
+  if (!c.sniffed && !c.rbuf.empty()) {
+    c.sniffed = true;
+    // The client gateway keeps the reference's telnet-able contract: raw
+    // JSON (no length prefix), one message per line/connection.
+    c.raw_json = c.rbuf[0] == '{';
+  }
+  if (c.raw_json) {
+    for (;;) {
+      auto nl = c.rbuf.find('\n');
+      std::string payload;
+      if (nl != std::string::npos) {
+        payload = c.rbuf.substr(0, nl);
+        c.rbuf.erase(0, nl + 1);
+      } else if (c.closed || c.fd < 0) {
+        payload.swap(c.rbuf);
+      } else {
+        // Wait for more bytes — but try a complete object eagerly so a
+        // no-newline sender (telnet paste) still goes through.
+        if (Json::parse(c.rbuf)) {
+          payload.swap(c.rbuf);
+        } else {
+          return;
+        }
+      }
+      while (!payload.empty() &&
+             (payload.back() == '\r' || payload.back() == ' '))
+        payload.pop_back();
+      if (payload.empty()) {
+        if (c.rbuf.empty()) return;
+        continue;
+      }
+      auto msg = from_payload(payload);
+      if (msg) {
+        ++frames_in_;
+        emit(replica_->receive(*msg));
+      }
+      if (c.rbuf.empty()) return;
+    }
+  }
+  // Framed replica-to-replica stream.
+  for (;;) {
+    if (c.rbuf.size() < 4) return;
+    uint32_t len = ((uint8_t)c.rbuf[0] << 24) | ((uint8_t)c.rbuf[1] << 16) |
+                   ((uint8_t)c.rbuf[2] << 8) | (uint8_t)c.rbuf[3];
+    if (len > (1u << 24)) {  // corrupt frame; drop the connection
+      close(c.fd);
+      c.closed = true;
+      return;
+    }
+    if (c.rbuf.size() < 4 + (size_t)len) return;
+    std::string payload = c.rbuf.substr(4, len);
+    c.rbuf.erase(0, 4 + (size_t)len);
+    auto msg = from_payload(payload);
+    if (msg) {
+      ++frames_in_;
+      emit(replica_->receive(*msg));
+    }
+  }
+}
+
+void ReplicaServer::flush(Conn& c) {
+  while (!c.wbuf.empty()) {
+    ssize_t w = send(c.fd, c.wbuf.data(), c.wbuf.size(), MSG_NOSIGNAL);
+    if (w > 0) {
+      c.wbuf.erase(0, (size_t)w);
+      continue;
+    }
+    if (w < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) return;
+    close(c.fd);
+    c.closed = true;
+    return;
+  }
+}
+
+void ReplicaServer::run_verify_batch() {
+  auto items = replica_->pending_items();
+  if (items.empty()) return;
+  ++batches_run_;
+  auto verdicts = verifier_->verify_batch(items);
+  emit(replica_->deliver_verdicts(verdicts));
+}
+
+void ReplicaServer::emit(Actions&& actions) {
+  for (auto& b : actions.broadcasts) {
+    for (int64_t dest = 0; dest < cfg_.n(); ++dest) {
+      if (dest != id_) send_to(dest, b.msg);
+    }
+  }
+  for (auto& s : actions.sends) send_to(s.dest, s.msg);
+  for (auto& r : actions.replies) dial_reply(r.client, r.msg);
+}
+
+int ReplicaServer::peer_fd(int64_t dest) {
+  auto it = peers_.find(dest);
+  if (it != peers_.end() && !it->second->closed) return it->second->fd;
+  const auto& ident = cfg_.replicas[dest];
+  int fd =
+      dial_tcp(ident.host + ":" + std::to_string(ident.port));
+  if (fd < 0) return -1;
+  set_nonblocking(fd);
+  auto c = std::make_unique<Conn>();
+  c->fd = fd;
+  peers_[dest] = std::move(c);
+  return fd;
+}
+
+void ReplicaServer::send_to(int64_t dest, const Message& m) {
+  if (dest == id_) {
+    emit(replica_->receive(m));
+    return;
+  }
+  if (peer_fd(dest) < 0) return;  // peer down: PBFT tolerates f of these
+  Conn& c = *peers_[dest];
+  c.wbuf += to_wire(m);
+  flush(c);
+}
+
+void ReplicaServer::dial_reply(const std::string& client_addr,
+                               const ClientReply& reply) {
+  // Dial back to the client's advertised address (the reference's contract,
+  // reference src/client_handler.rs:75-84): raw JSON + newline, then close.
+  int fd = dial_tcp(client_addr);
+  if (fd < 0) return;
+  std::string payload = reply.to_json().dump() + "\n";
+  size_t off = 0;
+  while (off < payload.size()) {
+    ssize_t w = send(fd, payload.data() + off, payload.size() - off,
+                     MSG_NOSIGNAL);
+    if (w <= 0) break;
+    off += (size_t)w;
+  }
+  close(fd);
+}
+
+std::string ReplicaServer::metrics_json() const {
+  JsonObject o;
+  o["replica"] = Json(id_);
+  o["port"] = Json(listen_port_);
+  o["frames_in"] = Json(frames_in_);
+  o["verify_batches"] = Json(batches_run_);
+  o["executed_upto"] = Json(replica_->executed_upto());
+  o["low_mark"] = Json(replica_->low_mark());
+  for (const auto& [k, v] : replica_->counters) o[k] = Json(v);
+  return Json(o).dump();
+}
+
+}  // namespace pbft

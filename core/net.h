@@ -1,0 +1,87 @@
+// TPU-era replacement for the reference's libp2p stack (SURVEY.md §5
+// "Distributed communication backend"): consensus messaging is a host-side
+// concern — plain TCP with 4-byte big-endian length-prefixed canonical-JSON
+// frames between replicas (the reference used varint-framed JSON over libp2p
+// substreams, reference src/protocol_config.rs:49-101), a static peer table
+// from network.json (which the reference shipped but never read, SURVEY.md
+// §2), and a raw-JSON client gateway preserving the reference's client
+// contract: JSON request in over TCP, reply *dialed back* to the client's
+// advertised address (reference src/client_handler.rs:75-84, README.md:33-43).
+//
+// Single-threaded poll() event loop; the consensus core stays I/O-free and
+// deterministic. Each loop iteration drains every readable socket into the
+// replica's inbox, then runs ONE verifier batch over everything that
+// arrived — the batching window that feeds the TPU verifier (BASELINE.json
+// north_star) emerges naturally from socket-level concurrency.
+#pragma once
+
+#include <cstdint>
+#include <map>
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "replica.h"
+#include "verifier.h"
+
+namespace pbft {
+
+// One buffered non-blocking TCP connection.
+struct Conn {
+  int fd = -1;
+  std::string rbuf;
+  std::string wbuf;
+  bool raw_json = false;   // client-gateway mode (sniffed: first byte '{')
+  bool sniffed = false;
+  bool closed = false;
+};
+
+class ReplicaServer {
+ public:
+  ReplicaServer(ClusterConfig cfg, int64_t id, const uint8_t seed[32],
+                std::unique_ptr<Verifier> verifier);
+  ~ReplicaServer();
+
+  // Bind + listen on the replica's configured port. Returns false on error.
+  bool start();
+  // Run until stop() (from a signal handler) — poll_once in a loop.
+  void run();
+  // One event-loop iteration: poll, read, batch-verify, emit.
+  void poll_once(int timeout_ms);
+  void stop() { stopping_ = true; }
+  bool stopped() const { return stopping_; }
+
+  Replica& replica() { return *replica_; }
+  int listen_port() const { return listen_port_; }
+  // One JSON metrics line (counters + queue depths).
+  std::string metrics_json() const;
+
+ private:
+  void accept_ready();
+  void handle_readable(Conn& c);
+  // Extract complete frames / JSON lines from c.rbuf into the replica.
+  void process_buffer(Conn& c);
+  void flush(Conn& c);
+  void run_verify_batch();
+  void emit(Actions&& actions);
+  void send_to(int64_t dest, const Message& m);
+  void dial_reply(const std::string& client_addr, const ClientReply& reply);
+  int peer_fd(int64_t dest);  // cached outbound connection (lazy dial)
+
+  ClusterConfig cfg_;
+  int64_t id_;
+  std::unique_ptr<Verifier> verifier_;
+  std::unique_ptr<Replica> replica_;
+  int listen_fd_ = -1;
+  int listen_port_ = 0;
+  bool stopping_ = false;
+  std::vector<std::unique_ptr<Conn>> conns_;       // accepted (inbound)
+  std::map<int64_t, std::unique_ptr<Conn>> peers_;  // dialed (outbound)
+  int64_t batches_run_ = 0;
+  int64_t frames_in_ = 0;
+};
+
+// "host:port" -> connected TCP fd (blocking connect), or -1.
+int dial_tcp(const std::string& host_port);
+
+}  // namespace pbft

@@ -1,0 +1,112 @@
+// pbftd — the native replica daemon (the rebuild of the reference's binary
+// `pbft [primary]`, reference src/main.rs:26-100, re-designed: the node role
+// is not an argv flag but derived from the config — primary = view % n —
+// and network.json is the real source of truth instead of dead config,
+// SURVEY.md §2 "Static topology config").
+//
+// Usage:
+//   pbftd --config network.json --id 0 --seed <64-hex>
+//         [--verifier cpu|host:port|/unix/path] [--metrics-every 5]
+//
+// The replica listens on its configured port for both framed peer traffic
+// and raw-JSON client connections (sniffed), verifies signature batches via
+// the pluggable backend (CPU in-process, or the colocated JAX/TPU service),
+// and dials replies back to clients.
+#include <csignal>
+#include <cstdio>
+#include <cstring>
+#include <ctime>
+#include <memory>
+#include <string>
+
+#include "net.h"
+#include "replica.h"
+#include "verifier.h"
+
+namespace {
+pbft::ReplicaServer* g_server = nullptr;
+void on_signal(int) {
+  if (g_server) g_server->stop();
+}
+}  // namespace
+
+int main(int argc, char** argv) {
+  std::string config_path, seed_hex, verifier_override;
+  int64_t id = -1;
+  int metrics_every = 0;
+  for (int i = 1; i < argc; ++i) {
+    std::string a = argv[i];
+    auto next = [&]() -> const char* { return i + 1 < argc ? argv[++i] : ""; };
+    if (a == "--config") config_path = next();
+    else if (a == "--id") id = std::atoll(next());
+    else if (a == "--seed") seed_hex = next();
+    else if (a == "--verifier") verifier_override = next();
+    else if (a == "--metrics-every") metrics_every = std::atoi(next());
+    else {
+      std::fprintf(stderr, "unknown arg: %s\n", a.c_str());
+      return 2;
+    }
+  }
+  if (config_path.empty() || id < 0 || seed_hex.size() != 64) {
+    std::fprintf(stderr,
+                 "usage: pbftd --config network.json --id N --seed <64-hex> "
+                 "[--verifier cpu|host:port|/unix/path] [--metrics-every S]\n");
+    return 2;
+  }
+
+  FILE* f = std::fopen(config_path.c_str(), "rb");
+  if (!f) {
+    std::fprintf(stderr, "cannot open %s\n", config_path.c_str());
+    return 1;
+  }
+  std::string text;
+  char buf[4096];
+  size_t r;
+  while ((r = std::fread(buf, 1, sizeof(buf), f)) > 0) text.append(buf, r);
+  std::fclose(f);
+
+  auto cfg = pbft::ClusterConfig::from_json_text(text);
+  if (!cfg || id >= cfg->n()) {
+    std::fprintf(stderr, "bad config or id out of range\n");
+    return 1;
+  }
+  uint8_t seed[32];
+  if (!pbft::from_hex(seed_hex, seed, 32)) {
+    std::fprintf(stderr, "bad --seed hex\n");
+    return 1;
+  }
+
+  std::string vsel = verifier_override.empty() ? cfg->verifier : verifier_override;
+  std::unique_ptr<pbft::Verifier> verifier;
+  if (vsel == "cpu") {
+    verifier = std::make_unique<pbft::CpuVerifier>();
+  } else {
+    verifier = std::make_unique<pbft::RemoteVerifier>(vsel);
+  }
+
+  pbft::ReplicaServer server(*cfg, id, seed, std::move(verifier));
+  if (!server.start()) {
+    std::fprintf(stderr, "replica %lld: bind failed on port %d\n",
+                 (long long)id, cfg->replicas[id].port);
+    return 1;
+  }
+  g_server = &server;
+  std::signal(SIGINT, on_signal);
+  std::signal(SIGTERM, on_signal);
+  std::fprintf(stderr, "pbftd replica %lld listening on %d (verifier=%s)\n",
+               (long long)id, server.listen_port(), vsel.c_str());
+
+  std::time_t last_metrics = std::time(nullptr);
+  while (!server.stopped()) {
+    server.poll_once(100);
+    if (metrics_every > 0) {
+      std::time_t now = std::time(nullptr);
+      if (now - last_metrics >= metrics_every) {
+        std::fprintf(stderr, "%s\n", server.metrics_json().c_str());
+        last_metrics = now;
+      }
+    }
+  }
+  std::fprintf(stderr, "%s\n", server.metrics_json().c_str());
+  return 0;
+}

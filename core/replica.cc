@@ -176,6 +176,10 @@ Actions Replica::accept_pre_prepare(const PrePrepare& pp) {
   Key key{pp.view, pp.seq};
   pre_prepares_.emplace(key, pp);
   counters["pre_prepares_accepted"] += 1;
+  // The primary's pre-prepare stands in for its prepare (PBFT §4.2): only
+  // backups multicast PREPARE, and prepared() wants 2f *backup* prepares,
+  // giving 2f+1 distinct replicas per certificate.
+  if (config_.primary_of(pp.view) == id_) return maybe_commit(key);
   Prepare prep;
   prep.view = pp.view;
   prep.seq = pp.seq;
@@ -207,9 +211,14 @@ bool Replica::prepared(const Key& key) const {
   if (pp == pre_prepares_.end()) return false;
   auto slot = prepares_.find(key);
   if (slot == prepares_.end()) return false;
+  // 2f matching prepares from non-primary replicas + the primary's
+  // pre-prepare = 2f+1 distinct members per certificate (PBFT §4.2's
+  // quorum-intersection requirement; counting a primary prepare would
+  // shrink certificates to 2f distinct replicas).
+  const int64_t primary = config_.primary_of(key.first);
   int64_t matching = 0;
   for (const auto& [rid, p] : slot->second) {
-    if (p.digest == pp->second.digest) matching += 1;
+    if (rid != primary && p.digest == pp->second.digest) matching += 1;
   }
   return matching >= 2 * config_.f();
 }
@@ -326,17 +335,28 @@ Actions Replica::insert_checkpoint(const Checkpoint& cp) {
   for (const auto& [rid, c] : slot) by_digest[c.digest] += 1;
   for (const auto& [d, count] : by_digest) {
     if (count >= 2 * config_.f() + 1) {
-      advance_watermark(cp.seq);
+      advance_watermark(cp.seq, d);
       break;
     }
   }
   return {};
 }
 
-void Replica::advance_watermark(int64_t stable_seq) {
+void Replica::advance_watermark(int64_t stable_seq,
+                                const std::string& stable_digest) {
   if (stable_seq <= low_mark_) return;
   low_mark_ = stable_seq;
   counters["checkpoints_stable"] += 1;
+  if (stable_seq > executed_upto_) {
+    // State-transfer-lite: 2f+1 replicas proved execution through
+    // stable_seq with this digest; adopt it instead of waiting for
+    // messages the pruning below is about to delete (that wait would
+    // deadlock execution forever). Full state transfer (fetching app
+    // state + per-client reply caches) is the complete recovery; the
+    // default app is stateless so adopting the digest is sufficient.
+    executed_upto_ = stable_seq;
+    from_hex(stable_digest, state_digest_, 32);
+  }
   auto prune_keys = [stable_seq](auto& log) {
     for (auto it = log.begin(); it != log.end();) {
       if (it->first.second <= stable_seq) it = log.erase(it);

@@ -104,7 +104,7 @@ class Replica {
   Actions drain_executions();
   Actions on_checkpoint(const Checkpoint& cp);
   Actions insert_checkpoint(const Checkpoint& cp);
-  void advance_watermark(int64_t stable_seq);
+  void advance_watermark(int64_t stable_seq, const std::string& stable_digest);
   bool prepared(const Key& key) const;
   bool committed_local(const Key& key) const;
   bool in_window(int64_t seq) const {

@@ -10,6 +10,7 @@
 #include <cstring>
 
 #include "ed25519.h"
+#include "net.h"
 
 namespace pbft {
 
@@ -43,24 +44,8 @@ bool RemoteVerifier::ensure_connected() {
     }
     return true;
   }
-  auto colon = target_.rfind(':');
-  if (colon == std::string::npos) return false;
-  std::string host = target_.substr(0, colon);
-  int port = std::atoi(target_.c_str() + colon + 1);
-  fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
-  if (fd_ < 0) return false;
-  int one = 1;
-  ::setsockopt(fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
-  sockaddr_in addr{};
-  addr.sin_family = AF_INET;
-  addr.sin_port = htons((uint16_t)port);
-  if (::inet_pton(AF_INET, host.c_str(), &addr.sin_addr) != 1 ||
-      ::connect(fd_, (sockaddr*)&addr, sizeof(addr)) != 0) {
-    ::close(fd_);
-    fd_ = -1;
-    return false;
-  }
-  return true;
+  fd_ = dial_tcp(target_);  // shared TCP dialer (net.cc)
+  return fd_ >= 0;
 }
 
 static bool write_all(int fd, const uint8_t* data, size_t n) {

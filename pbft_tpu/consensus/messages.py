@@ -78,8 +78,31 @@ def to_wire(msg: Message) -> bytes:
     return len(payload).to_bytes(4, "big") + payload
 
 
+_INT64_MIN, _INT64_MAX = -(2**63), 2**63 - 1
+
+
+def _check_int64(obj) -> None:
+    """Reject integers outside int64: the C++ runtime parses int64 and
+    *drops* out-of-range messages, so Python must reject the same set or
+    the two implementations would digest different canonical bytes for
+    the same wire message (a consensus divergence)."""
+    if isinstance(obj, bool):
+        return
+    if isinstance(obj, int):
+        if not (_INT64_MIN <= obj <= _INT64_MAX):
+            raise ValueError(f"integer out of int64 range: {obj}")
+    elif isinstance(obj, dict):
+        for v in obj.values():
+            _check_int64(v)
+    elif isinstance(obj, list):
+        for v in obj:
+            _check_int64(v)
+
+
 def from_wire(frame: bytes) -> Message:
-    return Message.from_dict(json.loads(frame.decode()))
+    d = json.loads(frame.decode())
+    _check_int64(d)
+    return Message.from_dict(d)
 
 
 @dataclasses.dataclass(frozen=True)

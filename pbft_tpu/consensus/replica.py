@@ -233,6 +233,11 @@ class Replica:
         key = (pp.view, pp.seq)
         self.pre_prepares[key] = pp
         self.counters["pre_prepares_accepted"] += 1
+        # The primary's pre-prepare stands in for its prepare (PBFT §4.2):
+        # only backups multicast PREPARE, and _prepared wants 2f *backup*
+        # prepares, giving 2f+1 distinct replicas per certificate.
+        if self.config.primary_of(pp.view) == self.id:
+            return self._maybe_commit(key)
         prep = self._sign(
             Prepare(view=pp.view, seq=pp.seq, digest=pp.digest, replica=self.id)
         )
@@ -257,13 +262,19 @@ class Replica:
         return self._maybe_commit(key)
 
     def _prepared(self, key: Tuple[int, int]) -> bool:
-        """pre-prepare + 2f matching prepares (PBFT §4.2; reference stub
-        `>= 1` at src/behavior.rs:177-182)."""
+        """pre-prepare + 2f matching *backup* prepares (PBFT §4.2; reference
+        stub `>= 1` at src/behavior.rs:177-182). Excluding the primary keeps
+        every prepared certificate at 2f+1 distinct replicas — counting a
+        primary prepare would shrink it to 2f and break quorum
+        intersection across views."""
         pp = self.pre_prepares.get(key)
         if pp is None:
             return False
+        primary = self.config.primary_of(key[0])
         matching = sum(
-            1 for p in self.prepares.get(key, {}).values() if p.digest == pp.digest
+            1
+            for rid, p in self.prepares.get(key, {}).items()
+            if rid != primary and p.digest == pp.digest
         )
         return matching >= 2 * self.config.f
 
@@ -375,15 +386,26 @@ class Replica:
         by_digest: Dict[str, int] = {}
         for c in slot.values():
             by_digest[c.digest] = by_digest.get(c.digest, 0) + 1
-        if max(by_digest.values()) >= 2 * self.config.f + 1:
-            self._advance_watermark(cp.seq)
+        for digest, count in by_digest.items():
+            if count >= 2 * self.config.f + 1:
+                self._advance_watermark(cp.seq, digest)
+                break
         return []
 
-    def _advance_watermark(self, stable_seq: int) -> None:
+    def _advance_watermark(self, stable_seq: int, stable_digest: str) -> None:
         if stable_seq <= self.low_mark:
             return
         self.low_mark = stable_seq
         self.counters["checkpoints_stable"] += 1
+        if stable_seq > self.executed_upto:
+            # State-transfer-lite: 2f+1 replicas proved execution through
+            # stable_seq with this digest; adopt it instead of waiting for
+            # messages the pruning below deletes (that wait would deadlock
+            # execution forever on a lagging replica). Full state transfer
+            # (app state + per-client reply caches) is the complete
+            # recovery; the default app is stateless so this suffices.
+            self.executed_upto = stable_seq
+            self.state_digest = bytes.fromhex(stable_digest)
         for log in (self.pre_prepares, self.prepares, self.commits):
             for key in [k for k in log if k[1] <= stable_seq]:
                 del log[key]

@@ -1,0 +1,18 @@
+"""pbft_tpu.net — the host-side runtime glue around the native daemon.
+
+- ``service``  — the JAX/TPU verifier service: the socket server the C++
+  ``pbftd`` ships signature batches to (core/verifier.h RemoteVerifier);
+  one vmap'd XLA launch per batch.
+- ``client``   — the PBFT client: sends a raw-JSON request to the primary
+  and collects dialed-back replies until f+1 match (PBFT §4.1; the
+  reference's manual telnet + ``nc -kl`` walkthrough, README.md:5-43,
+  scripted).
+- ``launcher`` — spawns a localhost cluster of ``pbftd`` processes from a
+  ClusterConfig (the reference ran 4 shells by hand).
+"""
+
+from .client import PbftClient
+from .launcher import LocalCluster, pbftd_path
+from .service import VerifierService
+
+__all__ = ["PbftClient", "LocalCluster", "VerifierService", "pbftd_path"]

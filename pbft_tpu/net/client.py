@@ -1,0 +1,104 @@
+"""The PBFT client, scripted (the reference's manual walkthrough: telnet a
+JSON request to the primary, catch the dialed-back replies with ``nc -kl``,
+README.md:5-43).
+
+A client sends a raw-JSON ClientRequest over TCP to a replica and runs a
+listener on its advertised dial-back address; it accepts a result once f+1
+replicas sent matching replies (PBFT §4.1 — the reply quorum that makes one
+faulty replica unable to lie to the client)."""
+
+from __future__ import annotations
+
+import json
+import socket
+import socketserver
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..consensus.config import ClusterConfig
+from ..consensus.messages import ClientRequest
+
+
+class PbftClient:
+    def __init__(self, config: ClusterConfig, host: str = "127.0.0.1", port: int = 0):
+        self.config = config
+        self.replies: List[dict] = []
+        self._lock = threading.Lock()
+        self._new_reply = threading.Condition(self._lock)
+        client = self
+
+        class Handler(socketserver.StreamRequestHandler):
+            def handle(self):
+                data = self.rfile.read()
+                for line in data.splitlines():
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        reply = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue
+                    with client._new_reply:
+                        client.replies.append(reply)
+                        client._new_reply.notify_all()
+
+        class Server(socketserver.ThreadingTCPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        self.server = Server((host, port), Handler)
+        self.address = "%s:%d" % self.server.server_address
+        self._thread = threading.Thread(
+            target=self.server.serve_forever, daemon=True
+        )
+        self._thread.start()
+        self._timestamp = 0
+
+    def close(self) -> None:
+        self.server.shutdown()
+        self.server.server_close()
+
+    # -- request path -------------------------------------------------------
+
+    def request(
+        self,
+        operation: str,
+        to_replica: int = 0,
+        timestamp: Optional[int] = None,
+    ) -> ClientRequest:
+        """Send one raw-JSON request to a replica (primary by default)."""
+        if timestamp is None:
+            self._timestamp += 1
+            timestamp = self._timestamp
+        req = ClientRequest(
+            operation=operation, timestamp=timestamp, client=self.address
+        )
+        ident = self.config.identity(to_replica)
+        with socket.create_connection((ident.host, ident.port), timeout=5) as s:
+            s.sendall(req.canonical() + b"\n")
+        return req
+
+    def wait_result(
+        self, timestamp: int, f: Optional[int] = None, timeout: float = 10.0
+    ) -> str:
+        """Block until f+1 matching replies for `timestamp` arrive."""
+        f = self.config.f if f is None else f
+        deadline = time.monotonic() + timeout
+        with self._new_reply:
+            while True:
+                by_result: Dict[Tuple[str, int], int] = {}
+                for r in self.replies:
+                    if r.get("timestamp") == timestamp:
+                        key = (r.get("result"), r.get("view"))
+                        by_result[key] = by_result.get(key, 0) + 1
+                for (result, _view), count in by_result.items():
+                    if count >= f + 1:
+                        return result
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"no f+1 reply quorum for t={timestamp}; "
+                        f"got {by_result}"
+                    )
+                self._new_reply.wait(remaining)

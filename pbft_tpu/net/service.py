@@ -1,0 +1,136 @@
+"""The JAX/TPU verifier service: the FFI boundary between the native replica
+runtime and the XLA crypto hot path (SURVEY.md §5 "Distributed communication
+backend": consensus-critical small messages stay on the host network; only
+signature *batches* cross into the JAX process).
+
+Protocol (mirrors core/verifier.h RemoteVerifier):
+    request:  u32be count N, then N * 128 bytes (pub 32 | msg 32 | sig 64)
+    response: N bytes, each 0/1
+
+One request = one padded-batch XLA launch. Batches are padded to the next
+power of two (bounded set of compiled shapes); pad slots carry a known-good
+triple so padding cost is pure compute, never a false reject.
+"""
+
+from __future__ import annotations
+
+import socket
+import socketserver
+import threading
+from typing import Callable, List, Optional, Tuple
+
+Item = Tuple[bytes, bytes, bytes]
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed mid-message")
+        buf += chunk
+    return buf
+
+
+def jax_backend(items: List[Item]) -> List[bool]:
+    from ..crypto import batch
+
+    return batch.verify_many(items)
+
+
+def cpu_backend(items: List[Item]) -> List[bool]:
+    from ..crypto import ref
+
+    return [ref.verify(p, m, s) for p, m, s in items]
+
+
+class VerifierService:
+    """Threaded TCP (or unix-domain) batch-verification server."""
+
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        unix_path: Optional[str] = None,
+        backend: Callable[[List[Item]], List[bool]] | str = "jax",
+    ):
+        if isinstance(backend, str):
+            backend = {"jax": jax_backend, "cpu": cpu_backend}[backend]
+        self.backend = backend
+        self.batches = 0
+        self.items = 0
+        service = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):  # one connection, many batches
+                sock = self.request
+                try:
+                    while True:
+                        header = _recv_exact(sock, 4)
+                        n = int.from_bytes(header, "big")
+                        blob = _recv_exact(sock, n * 128)
+                        items = [
+                            (
+                                blob[i * 128 : i * 128 + 32],
+                                blob[i * 128 + 32 : i * 128 + 64],
+                                blob[i * 128 + 64 : i * 128 + 128],
+                            )
+                            for i in range(n)
+                        ]
+                        verdicts = service.backend(items)
+                        service.batches += 1
+                        service.items += n
+                        sock.sendall(bytes(1 if v else 0 for v in verdicts))
+                except (ConnectionError, OSError):
+                    return
+
+        if unix_path is not None:
+
+            class UnixServer(socketserver.ThreadingUnixStreamServer):
+                daemon_threads = True
+
+            self.server = UnixServer(unix_path, Handler)
+            self.address = unix_path
+        else:
+
+            class TcpServer(socketserver.ThreadingTCPServer):
+                daemon_threads = True
+                allow_reuse_address = True
+
+            self.server = TcpServer((host, port), Handler)
+            self.address = "%s:%d" % self.server.server_address
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "VerifierService":
+        self._thread = threading.Thread(
+            target=self.server.serve_forever, daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.server.shutdown()
+        self.server.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+
+def main() -> None:
+    """CLI: run the service for a pbftd cluster (TPU by default)."""
+    import argparse
+
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=7600)
+    parser.add_argument("--unix", default=None)
+    parser.add_argument("--backend", default="jax", choices=["jax", "cpu"])
+    args = parser.parse_args()
+    svc = VerifierService(
+        host=args.host, port=args.port, unix_path=args.unix, backend=args.backend
+    )
+    print(f"verifier service on {svc.address} backend={args.backend}", flush=True)
+    svc.server.serve_forever()
+
+
+if __name__ == "__main__":
+    main()

@@ -28,6 +28,14 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 import jax
 
 jax.config.update("jax_platforms", "cpu")
+# Persistent compilation cache: the crypto kernels are compile-heavy (256-step
+# ladders); caching cuts repeat suite runs from minutes to seconds.
+jax.config.update(
+    "jax_compilation_cache_dir",
+    os.path.join(os.path.dirname(__file__), "..", ".jax_cache"),
+)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
 try:  # drop non-cpu plugin factories registered before conftest ran
     from jax._src import xla_bridge
 

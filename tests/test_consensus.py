@@ -57,13 +57,15 @@ def fresh_replica(n=4, rid=0):
 def test_primary_pre_prepare_broadcast():
     r, config, _ = fresh_replica(rid=0)
     actions = r.on_client_request(mk_request())
-    kinds = [type(a).__name__ for a in actions]
-    # PrePrepare broadcast, then own Prepare broadcast (reference
-    # src/behavior.rs:63-124: primary logs its own pre-prepare AND prepare).
-    assert kinds[0] == "Broadcast" and isinstance(actions[0].msg, PrePrepare)
-    assert isinstance(actions[1].msg, Prepare)
+    # Exactly one PrePrepare broadcast; the primary sends NO prepare — its
+    # pre-prepare stands in for it (PBFT §4.2), so prepared certificates
+    # always contain 2f+1 distinct replicas. (The reference had the primary
+    # log its own prepare, reference src/behavior.rs:63-124, which shrinks
+    # the certificate to 2f distinct members.)
+    assert [type(a).__name__ for a in actions] == ["Broadcast"]
+    assert isinstance(actions[0].msg, PrePrepare)
     assert r.pre_prepares[(0, 1)].digest == actions[0].msg.digest
-    assert 0 in r.prepares[(0, 1)]
+    assert (0, 1) not in r.prepares
 
 
 def test_backup_forwards_request_to_primary():
@@ -76,7 +78,7 @@ def test_quorum_thresholds_exact():
     """prepared needs 2f PREPAREs; committed-local needs 2f+1 COMMITs."""
     r, config, seeds = fresh_replica(n=4, rid=1)  # backup; f=1
     primary = Replica(config, 0, seeds[0])
-    [pp_bcast, _] = primary.on_client_request(mk_request())
+    [pp_bcast] = primary.on_client_request(mk_request())
     pp = pp_bcast.msg
 
     out = r._dispatch(pp)
@@ -108,7 +110,7 @@ def test_quorum_thresholds_exact():
 def test_conflicting_pre_prepare_rejected():
     r, config, seeds = fresh_replica(n=4, rid=1)
     primary = Replica(config, 0, seeds[0])
-    [pp_bcast, _] = primary.on_client_request(mk_request(op="first"))
+    [pp_bcast] = primary.on_client_request(mk_request(op="first"))
     r._dispatch(pp_bcast.msg)
     # Equivocation: same (v, n), different digest.
     req2 = mk_request(op="second", t=2)
@@ -149,7 +151,7 @@ def test_watermark_rejects_out_of_window():
 def test_bad_signature_dropped_via_verdicts():
     r, config, seeds = fresh_replica(n=4, rid=1)
     primary = Replica(config, 0, seeds[0])
-    [pp_bcast, _] = primary.on_client_request(mk_request())
+    [pp_bcast] = primary.on_client_request(mk_request())
     tampered = dataclasses.replace(pp_bcast.msg, sig="00" * 64)
     r.receive(tampered)
     items = r.pending_items()
@@ -260,6 +262,57 @@ def test_checkpoint_advances_watermark_and_truncates():
         assert all(k[1] > interval for k in r.prepares)
         assert all(k[1] > interval for k in r.commits)
         assert r.counters["checkpoints_stable"] == 1
+
+
+def test_prepared_certificate_excludes_primary_prepare():
+    """A forged 'prepare' claiming to be from the primary must not count
+    toward the 2f threshold (quorum-intersection regression)."""
+    r, config, seeds = fresh_replica(n=4, rid=1)
+    primary = Replica(config, 0, seeds[0])
+    [pp_bcast] = primary.on_client_request(mk_request())
+    pp = pp_bcast.msg
+    r._dispatch(pp)  # r logs its own prepare (1 backup prepare)
+    key = (0, 1)
+    # A prepare from the primary (even correctly signed) does not count.
+    primary_prep = primary._sign(
+        Prepare(view=0, seq=1, digest=pp.digest, replica=0)
+    )
+    r._dispatch(primary_prep)
+    assert not r._prepared(key)
+    # A second *backup* prepare does.
+    other = Replica(config, 2, seeds[2])
+    r._dispatch(other._sign(Prepare(view=0, seq=1, digest=pp.digest, replica=2)))
+    assert r._prepared(key)
+
+
+def test_lagging_replica_adopts_stable_checkpoint():
+    """Watermark advancement past unexecuted seqs must not deadlock
+    execution (regression: pruning pending_execution without adopting the
+    proven checkpoint left executed_upto stuck forever)."""
+    c = Cluster(n=4)
+    interval = c.config.checkpoint_interval
+    # Replica 3 misses everything up to the checkpoint.
+    for dst in range(3):
+        c.dropped_links.add((dst, 3))
+        c.dropped_links.add((3, dst))
+    for i in range(interval):
+        c.submit(f"op-{i}")
+        c.run(max_steps=500)
+    assert c.replicas[3].executed_upto == 0
+    # Reconnect and run through the NEXT checkpoint boundary: checkpoints
+    # are broadcast at execution time, so the healed replica adopts the
+    # stable checkpoint (state-transfer-lite) when the cluster next
+    # checkpoints — the lag is bounded by one interval instead of forever.
+    c.dropped_links.clear()
+    reqs = [c.submit(f"healed-{i}") for i in range(interval)]
+    for _ in range(interval):
+        c.run(max_steps=1000)
+    for req in reqs:
+        c.committed_result(req.timestamp)
+    r3 = c.replicas[3]
+    assert r3.low_mark == 2 * interval
+    assert r3.executed_upto == 2 * interval
+    assert r3.state_digest == c.replicas[0].state_digest
 
 
 def test_jax_verifier_cluster_equivalence():

@@ -1,0 +1,82 @@
+"""Multi-process integration: the reference's README walkthrough, scripted
+(SURVEY.md §4 item 4) — real pbftd processes on loopback, a real client,
+real dialed-back replies. Requires the native toolchain (cmake+ninja)."""
+
+import pytest
+
+from pbft_tpu import native
+from pbft_tpu.net import LocalCluster, PbftClient, VerifierService
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native core not built"
+)
+
+
+def test_readme_scenario_end_to_end():
+    """4 replicas (f=1), 1 client, single request — BASELINE.md config 1."""
+    with LocalCluster(n=4, verifier="cpu") as cluster:
+        client = PbftClient(cluster.config)
+        try:
+            req = client.request("hello pbft")
+            result = client.wait_result(req.timestamp, timeout=15)
+            assert result == "awesome!"
+        finally:
+            client.close()
+
+
+def test_request_to_backup_is_forwarded():
+    """Backups forward to the primary (reference TODO src/client_handler.rs:66-68)."""
+    with LocalCluster(n=4, verifier="cpu") as cluster:
+        client = PbftClient(cluster.config)
+        try:
+            req = client.request("via backup", to_replica=2)
+            result = client.wait_result(req.timestamp, timeout=15)
+            assert result == "awesome!"
+        finally:
+            client.close()
+
+
+def test_liveness_with_f_crashed_replicas():
+    """f=1 crash-stop: the cluster still commits (2f+1 of 3 live replicas)."""
+    with LocalCluster(n=4, verifier="cpu") as cluster:
+        cluster.kill(3)
+        client = PbftClient(cluster.config)
+        try:
+            req = client.request("with a dead backup")
+            result = client.wait_result(req.timestamp, timeout=15)
+            assert result == "awesome!"
+        finally:
+            client.close()
+
+
+def test_many_requests_pipeline():
+    """A burst of requests commits in order — the batching window carries
+    multiple concurrent (view, seq) rounds (BASELINE.md config 2 shape)."""
+    with LocalCluster(n=4, verifier="cpu") as cluster:
+        client = PbftClient(cluster.config)
+        try:
+            reqs = [client.request(f"op-{i}") for i in range(10)]
+            for r in reqs:
+                assert client.wait_result(r.timestamp, timeout=20) == "awesome!"
+        finally:
+            client.close()
+
+
+def test_remote_verifier_service_path():
+    """pbftd -> RemoteVerifier -> Python VerifierService over TCP: the same
+    socket protocol the TPU service uses (cpu backend keeps the test light;
+    the JAX batch path itself is covered in test_parallel/test_ed25519_jax)."""
+    svc = VerifierService(backend="cpu").start()
+    try:
+        with LocalCluster(n=4, verifier=svc.address) as cluster:
+            client = PbftClient(cluster.config)
+            try:
+                req = client.request("via remote verifier")
+                result = client.wait_result(req.timestamp, timeout=15)
+                assert result == "awesome!"
+            finally:
+                client.close()
+        assert svc.batches > 0
+        assert svc.items > 0
+    finally:
+        svc.stop()
