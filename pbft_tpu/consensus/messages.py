@@ -172,6 +172,74 @@ class Commit(Message):
 
 
 @dataclasses.dataclass(frozen=True)
+class ViewChange(Message):
+    """<VIEW-CHANGE, v+1, n, C, P, i> (PBFT §4.4 — absent from the
+    reference, whose View was a constant with no mutation API, reference
+    src/view.rs:1-13).
+
+    - ``last_stable_seq``/``checkpoint_proof``: n and C — 2f+1 checkpoint
+      message dicts proving the replica's last stable checkpoint.
+    - ``prepared_proofs``: P — one entry per sequence prepared above n:
+      {"pre_prepare": <dict>, "prepares": [<dict>, ...]} with 2f matching
+      backup prepares each. Stored as raw dicts: they are *evidence*
+      (re-validated structurally + cryptographically by the receiver),
+      not live protocol messages."""
+
+    TYPE: ClassVar[str] = "view-change"
+    new_view: int
+    last_stable_seq: int
+    checkpoint_proof: tuple
+    prepared_proofs: tuple
+    replica: int
+    sig: str = ""
+
+    def __post_init__(self):
+        # JSON round-trips tuples as lists; normalize for equality.
+        object.__setattr__(self, "checkpoint_proof", tuple(self.checkpoint_proof))
+        object.__setattr__(self, "prepared_proofs", tuple(self.prepared_proofs))
+
+    def to_dict(self) -> dict:
+        d = super().to_dict()
+        d["checkpoint_proof"] = list(self.checkpoint_proof)
+        d["prepared_proofs"] = list(self.prepared_proofs)
+        return d
+
+
+@dataclasses.dataclass(frozen=True)
+class NewView(Message):
+    """<NEW-VIEW, v+1, V, O> (PBFT §4.4): V = 2f+1 view-change message
+    dicts, O = the new primary's re-issued pre-prepare dicts for every
+    in-flight sequence (null requests fill gaps)."""
+
+    TYPE: ClassVar[str] = "new-view"
+    new_view: int
+    view_changes: tuple
+    pre_prepares: tuple
+    replica: int
+    sig: str = ""
+
+    def __post_init__(self):
+        object.__setattr__(self, "view_changes", tuple(self.view_changes))
+        object.__setattr__(self, "pre_prepares", tuple(self.pre_prepares))
+
+    def to_dict(self) -> dict:
+        d = super().to_dict()
+        d["view_changes"] = list(self.view_changes)
+        d["pre_prepares"] = list(self.pre_prepares)
+        return d
+
+
+NULL_CLIENT = "<null>"
+
+
+def null_request() -> "ClientRequest":
+    """Filler for sequence gaps in a new view (PBFT §4.4: 'a special null
+    request which goes through the protocol like other requests but whose
+    execution is a no-op')."""
+    return ClientRequest(operation="<null>", timestamp=0, client=NULL_CLIENT)
+
+
+@dataclasses.dataclass(frozen=True)
 class Checkpoint(Message):
     """<CHECKPOINT, n, d, i>: state digest at sequence n; 2f+1 matching
     checkpoints advance the low watermark (PBFT §4.3; a reference TODO,

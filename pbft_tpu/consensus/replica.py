@@ -33,14 +33,18 @@ from typing import Callable, Dict, List, Optional, Set, Tuple
 from ..crypto import ref as crypto
 from .config import ClusterConfig
 from .messages import (
+    NULL_CLIENT,
     Checkpoint,
     ClientReply,
     ClientRequest,
     Commit,
     Message,
+    NewView,
     Prepare,
     PrePrepare,
+    ViewChange,
     blake2b_256,
+    null_request,
     with_sig,
 )
 
@@ -98,6 +102,13 @@ class Replica:
         self.last_reply: Dict[str, ClientReply] = {}
         self.checkpoints: Dict[int, Dict[int, Checkpoint]] = {}
         self.state_digest = blake2b_256(b"pbft-genesis")
+        self.stable_proof: List[dict] = []  # 2f+1 checkpoint dicts @ low_mark
+        # View change (PBFT §4.4; the reference had no view mutation at all,
+        # reference src/view.rs:1-13).
+        self.in_view_change = False
+        self.pending_view = 0
+        self.view_changes: Dict[int, Dict[int, ViewChange]] = {}
+        self.new_view_sent: Set[int] = set()
         self._inbox: List[Message] = []
         self.counters: Dict[str, int] = {
             "sig_verified": 0,
@@ -108,6 +119,8 @@ class Replica:
             "executed": 0,
             "duplicate_requests": 0,
             "checkpoints_stable": 0,
+            "view_changes_started": 0,
+            "view_changes_completed": 0,
         }
 
     # -- identity helpers ---------------------------------------------------
@@ -212,12 +225,18 @@ class Replica:
             return self._on_commit(msg)
         if isinstance(msg, Checkpoint):
             return self._on_checkpoint(msg)
+        if isinstance(msg, ViewChange):
+            return self._on_view_change(msg)
+        if isinstance(msg, NewView):
+            return self._on_new_view(msg)
         if isinstance(msg, ClientRequest):
             return self.on_client_request(msg)
         return []
 
     def _on_pre_prepare(self, pp: PrePrepare) -> List[Action]:
         # validate (reference src/behavior.rs:126-157 + watermark TODO :154)
+        if self.in_view_change:
+            return []  # §4.4: only checkpoint/view-change/new-view accepted
         if pp.view != self.view or pp.replica != self.primary:
             return []
         if pp.request.digest() != pp.digest:
@@ -246,7 +265,7 @@ class Replica:
         return out
 
     def _on_prepare(self, p: Prepare) -> List[Action]:
-        if p.view != self.view:
+        if self.in_view_change or p.view != self.view:
             return []
         if not (self.low_mark < p.seq <= self.high_mark):
             return []
@@ -291,7 +310,7 @@ class Replica:
         return out
 
     def _on_commit(self, c: Commit) -> List[Action]:
-        if c.view != self.view:
+        if self.in_view_change or c.view != self.view:
             return []
         if not (self.low_mark < c.seq <= self.high_mark):
             return []
@@ -334,35 +353,46 @@ class Replica:
         while self.executed_upto + 1 in self.pending_execution:
             seq = self.executed_upto + 1
             view, digest = self.pending_execution.pop(seq)
+            self.executed_upto = seq
             pp = self.pre_prepares.get((view, seq))
             if pp is None:
                 # Watermark advanced past this seq (others checkpointed it);
                 # recovering the missed execution needs state transfer, which
                 # is a later-round capability — skip safely.
-                self.executed_upto = seq
                 continue
             req = pp.request
-            self.executed_upto = seq
-            last = self.last_timestamp.get(req.client)
-            if last is not None and req.timestamp <= last:
-                self.counters["duplicate_requests"] += 1
-                continue  # exactly-once (reference src/behavior.rs:391-398)
-            result = self._app(req.operation, seq)
-            self.counters["executed"] += 1
-            self.state_digest = hashlib.blake2b(
-                self.state_digest + result.encode() + seq.to_bytes(8, "big"),
-                digest_size=32,
-            ).digest()
-            self.last_timestamp[req.client] = req.timestamp
-            reply = ClientReply(
-                view=view,
-                timestamp=req.timestamp,
-                client=req.client,
-                replica=self.id,
-                result=result,
-            )
-            self.last_reply[req.client] = reply
-            out.append(Reply(req.client, reply))
+            if req.client == NULL_CLIENT:
+                # Null request (view-change gap filler, PBFT §4.4): the
+                # execution is a no-op and nobody awaits a reply, but it
+                # still advances the sequence and the state digest chain.
+                self.state_digest = hashlib.blake2b(
+                    self.state_digest + b"<null>" + seq.to_bytes(8, "big"),
+                    digest_size=32,
+                ).digest()
+            else:
+                last = self.last_timestamp.get(req.client)
+                if last is not None and req.timestamp <= last:
+                    # exactly-once (reference src/behavior.rs:391-398)
+                    self.counters["duplicate_requests"] += 1
+                else:
+                    result = self._app(req.operation, seq)
+                    self.counters["executed"] += 1
+                    self.state_digest = hashlib.blake2b(
+                        self.state_digest
+                        + result.encode()
+                        + seq.to_bytes(8, "big"),
+                        digest_size=32,
+                    ).digest()
+                    self.last_timestamp[req.client] = req.timestamp
+                    reply = ClientReply(
+                        view=view,
+                        timestamp=req.timestamp,
+                        client=req.client,
+                        replica=self.id,
+                        result=result,
+                    )
+                    self.last_reply[req.client] = reply
+                    out.append(Reply(req.client, reply))
             if seq % self.config.checkpoint_interval == 0:
                 cp = self._sign(
                     Checkpoint(seq=seq, digest=self.state_digest.hex(), replica=self.id)
@@ -388,9 +418,294 @@ class Replica:
             by_digest[c.digest] = by_digest.get(c.digest, 0) + 1
         for digest, count in by_digest.items():
             if count >= 2 * self.config.f + 1:
+                # Keep the 2f+1 matching checkpoint messages: they are the
+                # C component of our next VIEW-CHANGE (PBFT §4.4).
+                proof = [
+                    c.to_dict() for c in slot.values() if c.digest == digest
+                ]
                 self._advance_watermark(cp.seq, digest)
+                self.stable_proof = proof
                 break
         return []
+
+    # -- view change (PBFT §4.4) -------------------------------------------
+    #
+    # The reference has no view mutation at all (reference src/view.rs:1-13);
+    # this is the paper protocol. Design note on verification: the *hot* path
+    # (pre-prepare/prepare/commit) is signature-gated through the batched
+    # TPU verifier (pending_items/deliver_verdicts); view changes are rare
+    # reconfiguration events, so the signatures nested inside their evidence
+    # (checkpoint certificates, prepared certificates, the view-change
+    # messages embedded in a NEW-VIEW) are verified inline on the host.
+
+    def _verify_inline(self, replica_id: int, signable: bytes, sig_hex: str) -> bool:
+        if not (0 <= replica_id < self.config.n):
+            return False
+        try:
+            sig = bytes.fromhex(sig_hex)
+        except ValueError:
+            return False
+        if len(sig) != 64:
+            return False
+        return crypto.verify(
+            self.config.identity(replica_id).pubkey_bytes(), signable, sig
+        )
+
+    def start_view_change(self, new_view: Optional[int] = None) -> List[Action]:
+        """Move to view v+1 (or `new_view`) and broadcast VIEW-CHANGE.
+
+        Called by the runtime when its request timer for the current
+        primary expires, or by the f+1 join rule below."""
+        floor = self.pending_view if self.in_view_change else self.view
+        v = (floor + 1) if new_view is None else new_view
+        if v <= floor:
+            return []
+        self.in_view_change = True
+        self.pending_view = v
+        self.counters["view_changes_started"] += 1
+        vc = self._sign(
+            ViewChange(
+                new_view=v,
+                last_stable_seq=self.low_mark,
+                checkpoint_proof=tuple(self.stable_proof),
+                prepared_proofs=tuple(self._prepared_proofs()),
+                replica=self.id,
+            )
+        )
+        out: List[Action] = [Broadcast(vc)]
+        out.extend(self._on_view_change(vc))  # log our own
+        return out
+
+    def _prepared_proofs(self) -> List[dict]:
+        """P: for each sequence prepared above the low watermark, the
+        pre-prepare plus its 2f matching backup prepares (highest view
+        wins when a sequence prepared in several views)."""
+        best: Dict[int, Tuple[int, dict]] = {}
+        for (view, seq), pp in self.pre_prepares.items():
+            if seq <= self.low_mark or not self._prepared((view, seq)):
+                continue
+            primary = self.config.primary_of(view)
+            preps = [
+                p.to_dict()
+                for rid, p in self.prepares[(view, seq)].items()
+                if rid != primary and p.digest == pp.digest
+            ]
+            entry = {"pre_prepare": pp.to_dict(), "prepares": preps}
+            if seq not in best or view > best[seq][0]:
+                best[seq] = (view, entry)
+        return [entry for _, (_, entry) in sorted(best.items())]
+
+    def _validate_view_change(self, vc: ViewChange) -> bool:
+        # C: 2f+1 checkpoint messages proving last_stable_seq.
+        if vc.last_stable_seq > 0:
+            seen: Set[int] = set()
+            by_digest: Dict[str, int] = {}
+            for d in vc.checkpoint_proof:
+                try:
+                    cp = Message.from_dict(dict(d))
+                except (KeyError, TypeError):
+                    return False
+                if not isinstance(cp, Checkpoint) or cp.seq != vc.last_stable_seq:
+                    return False
+                if cp.replica in seen:
+                    return False
+                if not self._verify_inline(cp.replica, cp.signable(), cp.sig):
+                    return False
+                seen.add(cp.replica)
+                by_digest[cp.digest] = by_digest.get(cp.digest, 0) + 1
+            if not by_digest or max(by_digest.values()) < 2 * self.config.f + 1:
+                return False
+        # P: each prepared certificate is internally consistent + signed.
+        for proof in vc.prepared_proofs:
+            try:
+                pp = Message.from_dict(dict(proof["pre_prepare"]))
+                preps = [Message.from_dict(dict(p)) for p in proof["prepares"]]
+            except (KeyError, TypeError):
+                return False
+            if not isinstance(pp, PrePrepare) or pp.seq <= vc.last_stable_seq:
+                return False
+            primary = self.config.primary_of(pp.view)
+            if pp.replica != primary or pp.request.digest() != pp.digest:
+                return False
+            if not self._verify_inline(primary, pp.signable(), pp.sig):
+                return False
+            seen = set()
+            for p in preps:
+                if not isinstance(p, Prepare):
+                    return False
+                if (p.view, p.seq, p.digest) != (pp.view, pp.seq, pp.digest):
+                    return False
+                if p.replica == primary or p.replica in seen:
+                    return False
+                if not self._verify_inline(p.replica, p.signable(), p.sig):
+                    return False
+                seen.add(p.replica)
+            if len(seen) < 2 * self.config.f:
+                return False
+        return True
+
+    def _on_view_change(self, vc: ViewChange) -> List[Action]:
+        if vc.new_view <= self.view:
+            return []
+        slot = self.view_changes.setdefault(vc.new_view, {})
+        if vc.replica in slot:
+            return []
+        if not self._validate_view_change(vc):
+            return []
+        slot[vc.replica] = vc
+        out: List[Action] = []
+        # Join rule (§4.5.2 liveness): f+1 replicas already moved past our
+        # view -> join the smallest such view, even if our timer has not
+        # fired (prevents a late replica from stalling in an abandoned view).
+        floor = self.pending_view if self.in_view_change else self.view
+        voters: Set[int] = set()
+        candidates: List[int] = []
+        for v, reps in self.view_changes.items():
+            if v > floor:
+                voters.update(reps)
+                candidates.append(v)
+        if len(voters) >= self.config.f + 1:
+            out.extend(self.start_view_change(min(candidates)))
+        if self.config.primary_of(vc.new_view) == self.id:
+            out.extend(self._maybe_new_view(vc.new_view))
+        return out
+
+    def _compute_o(
+        self, vcs: List[ViewChange]
+    ) -> Tuple[int, List[Tuple[int, str, Optional[dict]]]]:
+        """(min_s, [(seq, digest, request_dict|None)]) — the O computation:
+        re-issue every sequence some quorum member prepared; null-fill gaps."""
+        min_s = max(vc.last_stable_seq for vc in vcs)
+        best: Dict[int, Tuple[int, str, dict]] = {}
+        for vc in vcs:
+            for proof in vc.prepared_proofs:
+                ppd = dict(proof["pre_prepare"])
+                n = ppd["seq"]
+                if n <= min_s:
+                    continue
+                if n not in best or ppd["view"] > best[n][0]:
+                    best[n] = (ppd["view"], ppd["digest"], ppd["request"])
+        entries: List[Tuple[int, str, Optional[dict]]] = []
+        max_s = max(best) if best else min_s
+        for n in range(min_s + 1, max_s + 1):
+            if n in best:
+                entries.append((n, best[n][1], best[n][2]))
+            else:
+                entries.append((n, null_request().digest(), None))
+        return min_s, entries
+
+    def _stable_digest_for(self, vcs: List[ViewChange], min_s: int) -> Optional[str]:
+        for vc in vcs:
+            if vc.last_stable_seq == min_s and vc.checkpoint_proof:
+                return dict(vc.checkpoint_proof[0])["digest"]
+        return None
+
+    def _maybe_new_view(self, v: int) -> List[Action]:
+        if v in self.new_view_sent:
+            return []
+        slot = self.view_changes.get(v, {})
+        if len(slot) < 2 * self.config.f + 1:
+            return []
+        # Deterministic V: the 2f+1 lowest replica ids.
+        vcs = [slot[rid] for rid in sorted(slot)[: 2 * self.config.f + 1]]
+        min_s, entries = self._compute_o(vcs)
+        pps = [
+            self._sign(
+                PrePrepare(
+                    view=v,
+                    seq=n,
+                    digest=digest,
+                    request=(
+                        ClientRequest(**{k: val for k, val in req.items() if k != "type"})
+                        if req is not None
+                        else null_request()
+                    ),
+                    replica=self.id,
+                )
+            )
+            for n, digest, req in entries
+        ]
+        nv = self._sign(
+            NewView(
+                new_view=v,
+                view_changes=tuple(vc.to_dict() for vc in vcs),
+                pre_prepares=tuple(pp.to_dict() for pp in pps),
+                replica=self.id,
+            )
+        )
+        self.new_view_sent.add(v)
+        out: List[Action] = [Broadcast(nv)]
+        out.extend(
+            self._enter_new_view(v, min_s, self._stable_digest_for(vcs, min_s), pps)
+        )
+        return out
+
+    def _on_new_view(self, nv: NewView) -> List[Action]:
+        if nv.new_view < self.view or (
+            nv.new_view == self.view and not self.in_view_change
+        ):
+            return []
+        if nv.replica != self.config.primary_of(nv.new_view):
+            return []
+        try:
+            vcs = [Message.from_dict(dict(d)) for d in nv.view_changes]
+            pps = [Message.from_dict(dict(d)) for d in nv.pre_prepares]
+        except (KeyError, TypeError):
+            return []
+        # V: 2f+1 distinct, correctly signed, valid view-changes for this view.
+        if len(vcs) < 2 * self.config.f + 1:
+            return []
+        seen: Set[int] = set()
+        for vc in vcs:
+            if not isinstance(vc, ViewChange) or vc.new_view != nv.new_view:
+                return []
+            if vc.replica in seen:
+                return []
+            if not self._verify_inline(vc.replica, vc.signable(), vc.sig):
+                return []
+            if not self._validate_view_change(vc):
+                return []
+            seen.add(vc.replica)
+        # O must equal our own recomputation from V (a Byzantine new primary
+        # cannot smuggle in requests nobody prepared).
+        min_s, entries = self._compute_o(vcs)
+        if len(pps) != len(entries):
+            return []
+        for pp, (n, digest, _req) in zip(pps, entries):
+            if not isinstance(pp, PrePrepare):
+                return []
+            if (pp.view, pp.seq, pp.digest) != (nv.new_view, n, digest):
+                return []
+            if pp.replica != nv.replica or pp.request.digest() != pp.digest:
+                return []
+            if not self._verify_inline(pp.replica, pp.signable(), pp.sig):
+                return []
+        return self._enter_new_view(
+            nv.new_view, min_s, self._stable_digest_for(vcs, min_s), pps
+        )
+
+    def _enter_new_view(
+        self,
+        v: int,
+        min_s: int,
+        stable_digest: Optional[str],
+        pps: List[PrePrepare],
+    ) -> List[Action]:
+        self.view = v
+        self.in_view_change = False
+        self.pending_view = 0
+        self.counters["view_changes_completed"] += 1
+        for past in [w for w in self.view_changes if w <= v]:
+            del self.view_changes[past]
+        if min_s > self.low_mark and stable_digest is not None:
+            self._advance_watermark(min_s, stable_digest)
+        # The new primary continues the sequence after the re-issued slots;
+        # harmless for backups (their seq_counter is unused until they lead).
+        self.seq_counter = max(min_s, max((pp.seq for pp in pps), default=min_s))
+        out: List[Action] = []
+        for pp in pps:
+            out.extend(self._on_pre_prepare(pp))
+        return out
 
     def _advance_watermark(self, stable_seq: int, stable_digest: str) -> None:
         if stable_seq <= self.low_mark:

@@ -81,7 +81,8 @@ class Cluster:
 
     @property
     def primary_id(self) -> int:
-        return self.replicas[0].config.primary_of(self.replicas[0].view)
+        view = max(r.view for r in self.replicas)
+        return self.config.primary_of(view)
 
     # -- transport ----------------------------------------------------------
 
@@ -138,6 +139,24 @@ class Cluster:
         while steps < max_steps and self.step():
             steps += 1
         return steps
+
+    # -- fault / timer injection --------------------------------------------
+
+    def crash(self, replica_id: int) -> None:
+        """Crash-stop: sever every link to and from the replica."""
+        for other in range(self.config.n):
+            self.dropped_links.add((replica_id, other))
+            self.dropped_links.add((other, replica_id))
+
+    def trigger_view_change(self, replica_ids=None, new_view=None) -> None:
+        """Fire the (runtime-owned) request timers: each listed replica
+        broadcasts VIEW-CHANGE (PBFT §4.4). In a real deployment the net
+        layer calls Replica.start_view_change when a forwarded request
+        isn't executed before its timeout."""
+        if replica_ids is None:
+            replica_ids = [r.id for r in self.replicas]
+        for rid in replica_ids:
+            self._emit(rid, self.replicas[rid].start_view_change(new_view))
 
     # -- assertions helpers -------------------------------------------------
 

@@ -1,0 +1,172 @@
+"""View change (PBFT §4.4) — the capability the reference stubbed entirely
+(its View was a constant with no mutation API, reference src/view.rs:1-13).
+
+Covers: primary failure -> new view elects primary 1 and in-flight requests
+survive; O-computation with prepared certificates and null gaps; the f+1
+join rule; Byzantine new-primary rejection (forged O); checkpoint-anchored
+view changes."""
+
+import dataclasses
+
+from pbft_tpu.consensus.config import make_local_cluster
+from pbft_tpu.consensus.messages import (
+    Message,
+    NewView,
+    PrePrepare,
+    ViewChange,
+    null_request,
+)
+from pbft_tpu.consensus.replica import Broadcast, Replica
+from pbft_tpu.consensus.simulation import Cluster
+
+
+def test_view_change_after_primary_crash():
+    c = Cluster(n=4)
+    c.crash(0)
+    # Backups' request timers fire (runtime responsibility) -> view change.
+    c.trigger_view_change([1, 2, 3])
+    c.run(max_steps=500)
+    live = [c.replicas[i] for i in (1, 2, 3)]
+    assert all(r.view == 1 for r in live)
+    assert all(not r.in_view_change for r in live)
+    assert c.primary_id == 1
+    # The cluster commits client requests in the new view.
+    req = c.submit("after view change")
+    c.run(max_steps=500)
+    assert c.committed_result(req.timestamp) == "awesome!"
+    assert len({r.state_digest for r in live}) == 1
+
+
+def test_in_flight_prepared_request_survives_view_change():
+    """A request prepared (but not committed) in view 0 must be re-issued
+    in view 1 and execute exactly once (PBFT §4.4 safety across views)."""
+    c = Cluster(n=4)
+    req = c.submit("survivor")
+    # Deliver pre-prepares + prepares, but drop every COMMIT so the round
+    # prepares without committing anywhere.
+    c.outbound_mutator = lambda src, msg: (
+        None if type(msg).__name__ == "Commit" else msg
+    )
+    c.run(max_steps=500)
+    assert all(r.executed_upto == 0 for r in c.replicas)
+    prepared_somewhere = [
+        r.id for r in c.replicas if r._prepared((0, 1))
+    ]
+    assert prepared_somewhere, "at least one replica must have prepared"
+    # Primary goes silent; commits flow again in the new view.
+    c.outbound_mutator = None
+    c.crash(0)
+    c.trigger_view_change([1, 2, 3])
+    c.run(max_steps=500)
+    live = [c.replicas[i] for i in (1, 2, 3)]
+    assert all(r.view == 1 for r in live)
+    # The survivor executed in the new view, exactly once.
+    assert c.committed_result(req.timestamp) == "awesome!"
+    assert all(r.executed_upto >= 1 for r in live)
+    assert all(r.counters["executed"] == 1 for r in live)
+    assert len({r.state_digest for r in live}) == 1
+
+
+def test_join_rule_f_plus_one():
+    """A replica whose timer never fired joins once f+1 others moved
+    (PBFT §4.5.2): only replicas 1 and 2 trigger; replica 3 follows."""
+    c = Cluster(n=4)
+    c.crash(0)
+    c.trigger_view_change([1, 2])  # f+1 = 2 explicit triggers
+    c.run(max_steps=500)
+    live = [c.replicas[i] for i in (1, 2, 3)]
+    assert all(r.view == 1 for r in live)
+    assert c.replicas[3].counters["view_changes_started"] == 1
+
+
+def test_new_view_with_forged_o_rejected():
+    """A Byzantine new primary cannot smuggle an unprepared request into O:
+    backups recompute O from V and drop a mismatched NEW-VIEW."""
+    config, seeds = make_local_cluster(4)
+    replicas = [Replica(config, i, seeds[i]) for i in range(4)]
+    # Gather legitimate VIEW-CHANGE messages for view 1 from replicas 2, 3
+    # plus primary-elect 1's own.
+    vcs = []
+    for rid in (1, 2, 3):
+        acts = replicas[rid].start_view_change()
+        for a in acts:
+            if isinstance(a, Broadcast) and isinstance(a.msg, ViewChange):
+                vcs.append(a.msg)
+    assert len(vcs) == 3
+    # Replica 1 (new primary) would send O = [] (nothing prepared). Forge a
+    # NEW-VIEW that injects a pre-prepare for an invented request.
+    evil_req = null_request()
+    forged_pp = replicas[1]._sign(
+        PrePrepare(view=1, seq=1, digest=evil_req.digest(), request=evil_req, replica=1)
+    )
+    forged = replicas[1]._sign(
+        NewView(
+            new_view=1,
+            view_changes=tuple(vc.to_dict() for vc in vcs),
+            pre_prepares=(forged_pp.to_dict(),),
+            replica=1,
+        )
+    )
+    out = replicas[2]._on_new_view(forged)
+    assert out == []
+    assert replicas[2].in_view_change  # still waiting for a valid NEW-VIEW
+    assert replicas[2].view == 0
+
+
+def test_view_change_after_checkpoint_anchors_min_s():
+    """View change above a stable checkpoint: min-s comes from C and the
+    new view resumes after it."""
+    c = Cluster(n=4)
+    interval = c.config.checkpoint_interval
+    for i in range(interval):
+        c.submit(f"op-{i}")
+        c.run(max_steps=500)
+    assert all(r.low_mark == interval for r in c.replicas)
+    c.crash(0)
+    c.trigger_view_change([1, 2, 3])
+    c.run(max_steps=500)
+    live = [c.replicas[i] for i in (1, 2, 3)]
+    assert all(r.view == 1 for r in live)
+    req = c.submit("post-checkpoint-vc")
+    c.run(max_steps=500)
+    assert c.committed_result(req.timestamp) == "awesome!"
+    assert all(r.executed_upto == interval + 1 for r in live)
+
+
+def test_cascading_view_change_skips_failed_primary():
+    """If the new primary is also dead, a second view change reaches
+    replica 2 (view 2). Needs f=2 (n=7) so two crashed replicas stay
+    within the fault budget."""
+    c = Cluster(n=7)
+    c.crash(0)
+    c.crash(1)
+    live_ids = [2, 3, 4, 5, 6]
+    c.trigger_view_change(live_ids, new_view=1)
+    c.run(max_steps=1000)
+    # View 1's primary (replica 1) is dead: no NEW-VIEW arrives; timers
+    # fire again for view 2.
+    c.trigger_view_change(live_ids, new_view=2)
+    c.run(max_steps=1000)
+    live = [c.replicas[i] for i in live_ids]
+    assert all(r.view == 2 for r in live)
+    assert all(not r.in_view_change for r in live)
+    assert c.primary_id == 2
+    req = c.submit("two hops later")
+    c.run(max_steps=1000)
+    assert c.committed_result(req.timestamp) == "awesome!"
+
+
+def test_view_change_message_roundtrip():
+    config, seeds = make_local_cluster(4)
+    r = Replica(config, 1, seeds[1])
+    [bcast] = [
+        a
+        for a in r.start_view_change()
+        if isinstance(a, Broadcast) and isinstance(a.msg, ViewChange)
+    ]
+    from pbft_tpu.consensus.messages import from_wire, to_wire
+
+    frame = to_wire(bcast.msg)
+    back = from_wire(frame[4:])
+    assert back == bcast.msg
+    assert back.signable() == bcast.msg.signable()
