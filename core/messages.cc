@@ -104,6 +104,29 @@ Json Checkpoint::to_json() const {
   return Json(std::move(o));
 }
 
+Json ViewChange::to_json() const {
+  JsonObject o;
+  o.emplace("checkpoint_proof", Json(checkpoint_proof));
+  o.emplace("last_stable_seq", last_stable_seq);
+  o.emplace("new_view", new_view);
+  o.emplace("prepared_proofs", Json(prepared_proofs));
+  o.emplace("replica", replica);
+  o.emplace("sig", sig);
+  o.emplace("type", "view-change");
+  return Json(std::move(o));
+}
+
+Json NewView::to_json() const {
+  JsonObject o;
+  o.emplace("new_view", new_view);
+  o.emplace("pre_prepares", Json(pre_prepares));
+  o.emplace("replica", replica);
+  o.emplace("sig", sig);
+  o.emplace("type", "new-view");
+  o.emplace("view_changes", Json(view_changes));
+  return Json(std::move(o));
+}
+
 MsgType type_of(const Message& m) {
   return static_cast<MsgType>(m.index());
 }
@@ -188,6 +211,31 @@ std::optional<Message> message_from_json(const Json& j) {
     if (!get_int(j, "seq", &r.seq) || !get_str(j, "digest", &r.digest) ||
         !get_int(j, "replica", &r.replica) || !get_str(j, "sig", &r.sig))
       return std::nullopt;
+    return Message(std::move(r));
+  }
+  if (type == "view-change") {
+    ViewChange r;
+    const Json* cp = j.find("checkpoint_proof");
+    const Json* pp = j.find("prepared_proofs");
+    if (!cp || !cp->is_array() || !pp || !pp->is_array() ||
+        !get_int(j, "new_view", &r.new_view) ||
+        !get_int(j, "last_stable_seq", &r.last_stable_seq) ||
+        !get_int(j, "replica", &r.replica) || !get_str(j, "sig", &r.sig))
+      return std::nullopt;
+    r.checkpoint_proof = cp->as_array();
+    r.prepared_proofs = pp->as_array();
+    return Message(std::move(r));
+  }
+  if (type == "new-view") {
+    NewView r;
+    const Json* vc = j.find("view_changes");
+    const Json* pp = j.find("pre_prepares");
+    if (!vc || !vc->is_array() || !pp || !pp->is_array() ||
+        !get_int(j, "new_view", &r.new_view) ||
+        !get_int(j, "replica", &r.replica) || !get_str(j, "sig", &r.sig))
+      return std::nullopt;
+    r.view_changes = vc->as_array();
+    r.pre_prepares = pp->as_array();
     return Message(std::move(r));
   }
   return std::nullopt;

@@ -24,6 +24,8 @@ enum class MsgType {
   kPrepare,
   kCommit,
   kCheckpoint,
+  kViewChange,
+  kNewView,
 };
 
 struct ClientRequest {
@@ -87,8 +89,35 @@ struct Checkpoint {
   Json to_json() const;
 };
 
+// <VIEW-CHANGE, v+1, n, C, P, i> (PBFT §4.4; absent from the reference —
+// its View was a constant with no mutation API, reference src/view.rs:1-13).
+// C and P are carried as raw JSON evidence (checkpoint / prepared
+// certificates), re-validated structurally + cryptographically on receipt.
+struct ViewChange {
+  int64_t new_view = 0;
+  int64_t last_stable_seq = 0;
+  JsonArray checkpoint_proof;
+  JsonArray prepared_proofs;
+  int64_t replica = 0;
+  std::string sig;
+
+  Json to_json() const;
+};
+
+// <NEW-VIEW, v+1, V, O> (PBFT §4.4): V = 2f+1 view-change dicts, O = the
+// new primary's re-issued pre-prepare dicts (null requests fill gaps).
+struct NewView {
+  int64_t new_view = 0;
+  JsonArray view_changes;
+  JsonArray pre_prepares;
+  int64_t replica = 0;
+  std::string sig;
+
+  Json to_json() const;
+};
+
 using Message = std::variant<ClientRequest, ClientReply, PrePrepare, Prepare,
-                             Commit, Checkpoint>;
+                             Commit, Checkpoint, ViewChange, NewView>;
 
 MsgType type_of(const Message& m);
 Json message_to_json(const Message& m);

@@ -118,6 +118,7 @@ void ReplicaServer::poll_once(int timeout_ms) {
   // The batching window: everything that arrived this iteration verifies
   // as one batch (one XLA launch on the TPU backend).
   run_verify_batch();
+  check_progress_timer();
   // Drop closed inbound connections.
   conns_.erase(
       std::remove_if(conns_.begin(), conns_.end(),
@@ -252,8 +253,63 @@ void ReplicaServer::emit(Actions&& actions) {
       if (dest != id_) send_to(dest, b.msg);
     }
   }
-  for (auto& s : actions.sends) send_to(s.dest, s.msg);
-  for (auto& r : actions.replies) dial_reply(r.client, r.msg);
+  for (auto& s : actions.sends) {
+    // A ClientRequest forwarded to the primary starts this replica's
+    // request timer (PBFT §4.4: a backup waits for the request to
+    // execute, else it suspects the primary).
+    if (auto* req = std::get_if<ClientRequest>(&s.msg)) {
+      if (vc_timeout_ms_ > 0 && waiting_requests_.size() < 10000) {
+        waiting_requests_[{req->client, req->timestamp}] =
+            std::chrono::steady_clock::now() +
+            std::chrono::milliseconds(vc_timeout_ms_);
+      }
+    }
+    send_to(s.dest, s.msg);
+  }
+  for (auto& r : actions.replies) {
+    waiting_requests_.erase({r.msg.client, r.msg.timestamp});
+    dial_reply(r.client, r.msg);
+  }
+}
+
+void ReplicaServer::check_progress_timer() {
+  if (vc_timeout_ms_ <= 0) return;
+  auto now = std::chrono::steady_clock::now();
+  // Expire stale forwarded-request entries (a superseded request never
+  // produces a reply here) after 10 timeouts.
+  for (auto it = waiting_requests_.begin(); it != waiting_requests_.end();) {
+    if (now - it->second > std::chrono::milliseconds(10 * vc_timeout_ms_)) {
+      it = waiting_requests_.erase(it);
+    } else {
+      ++it;
+    }
+  }
+  bool pending = !waiting_requests_.empty() || replica_->has_unexecuted();
+  if (!pending) {
+    timer_armed_ = false;
+    timer_backoff_ = 1;
+    return;
+  }
+  if (!timer_armed_) {
+    timer_armed_ = true;
+    timer_exec_snapshot_ = replica_->executed_upto();
+    timer_view_snapshot_ = replica_->view();
+    timer_deadline_ =
+        now + std::chrono::milliseconds(vc_timeout_ms_ * timer_backoff_);
+    return;
+  }
+  if (now < timer_deadline_) return;
+  if (replica_->executed_upto() > timer_exec_snapshot_ ||
+      replica_->view() > timer_view_snapshot_) {
+    // Progress happened; rearm fresh.
+    timer_backoff_ = 1;
+  } else {
+    // No progress within the timeout: suspect the primary. Exponential
+    // backoff keeps cascading view changes from thrashing (§4.5.2).
+    timer_backoff_ = std::min(timer_backoff_ * 2, 64);
+    emit(replica_->start_view_change());
+  }
+  timer_armed_ = false;  // rearmed on the next tick while work pends
 }
 
 int ReplicaServer::peer_fd(int64_t dest) {
@@ -306,6 +362,8 @@ std::string ReplicaServer::metrics_json() const {
   o["verify_batches"] = Json(batches_run_);
   o["executed_upto"] = Json(replica_->executed_upto());
   o["low_mark"] = Json(replica_->low_mark());
+  o["view"] = Json(replica_->view());
+  o["in_view_change"] = Json(replica_->in_view_change());
   for (const auto& [k, v] : replica_->counters) o[k] = Json(v);
   return Json(o).dump();
 }

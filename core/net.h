@@ -15,6 +15,7 @@
 // north_star) emerges naturally from socket-level concurrency.
 #pragma once
 
+#include <chrono>
 #include <cstdint>
 #include <map>
 #include <memory>
@@ -56,6 +57,13 @@ class ReplicaServer {
   // One JSON metrics line (counters + queue depths).
   std::string metrics_json() const;
 
+  // Request/progress timer (PBFT §4.4 liveness): when a client request is
+  // waiting (forwarded to the primary, or accepted pre-prepares sit
+  // unexecuted) and no progress happens within `ms`, the replica starts a
+  // view change; the timeout doubles per consecutive failed view
+  // (§4.5.2's exponential backoff). 0 disables.
+  void set_view_change_timeout(int ms) { vc_timeout_ms_ = ms; }
+
  private:
   void accept_ready();
   void handle_readable(Conn& c);
@@ -68,10 +76,22 @@ class ReplicaServer {
   void dial_reply(const std::string& client_addr, const ClientReply& reply);
   int peer_fd(int64_t dest);  // cached outbound connection (lazy dial)
 
+  void check_progress_timer();
+
   ClusterConfig cfg_;
   int64_t id_;
   std::unique_ptr<Verifier> verifier_;
   std::unique_ptr<Replica> replica_;
+  int vc_timeout_ms_ = 0;
+  bool timer_armed_ = false;
+  int timer_backoff_ = 1;
+  std::chrono::steady_clock::time_point timer_deadline_{};
+  int64_t timer_exec_snapshot_ = 0;
+  int64_t timer_view_snapshot_ = 0;
+  // Forwarded-but-unreplied client requests: (client addr, timestamp).
+  std::map<std::pair<std::string, int64_t>,
+           std::chrono::steady_clock::time_point>
+      waiting_requests_;
   int listen_fd_ = -1;
   int listen_port_ = 0;
   bool stopping_ = false;

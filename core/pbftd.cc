@@ -34,6 +34,7 @@ int main(int argc, char** argv) {
   std::string config_path, seed_hex, verifier_override;
   int64_t id = -1;
   int metrics_every = 0;
+  int vc_timeout_ms = 0;
   for (int i = 1; i < argc; ++i) {
     std::string a = argv[i];
     auto next = [&]() -> const char* { return i + 1 < argc ? argv[++i] : ""; };
@@ -42,6 +43,7 @@ int main(int argc, char** argv) {
     else if (a == "--seed") seed_hex = next();
     else if (a == "--verifier") verifier_override = next();
     else if (a == "--metrics-every") metrics_every = std::atoi(next());
+    else if (a == "--vc-timeout-ms") vc_timeout_ms = std::atoi(next());
     else {
       std::fprintf(stderr, "unknown arg: %s\n", a.c_str());
       return 2;
@@ -85,6 +87,7 @@ int main(int argc, char** argv) {
   }
 
   pbft::ReplicaServer server(*cfg, id, seed, std::move(verifier));
+  if (vc_timeout_ms > 0) server.set_view_change_timeout(vc_timeout_ms);
   if (!server.start()) {
     std::fprintf(stderr, "replica %lld: bind failed on port %d\n",
                  (long long)id, cfg->replicas[id].port);

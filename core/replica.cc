@@ -109,6 +109,8 @@ int64_t replica_of(const Message& m) {
   if (auto* p = std::get_if<Prepare>(&m)) return p->replica;
   if (auto* c = std::get_if<Commit>(&m)) return c->replica;
   if (auto* cp = std::get_if<Checkpoint>(&m)) return cp->replica;
+  if (auto* vc = std::get_if<ViewChange>(&m)) return vc->replica;
+  if (auto* nv = std::get_if<NewView>(&m)) return nv->replica;
   return -1;
 }
 const std::string* sig_of(const Message& m) {
@@ -116,7 +118,18 @@ const std::string* sig_of(const Message& m) {
   if (auto* p = std::get_if<Prepare>(&m)) return &p->sig;
   if (auto* c = std::get_if<Commit>(&m)) return &c->sig;
   if (auto* cp = std::get_if<Checkpoint>(&m)) return &cp->sig;
+  if (auto* vc = std::get_if<ViewChange>(&m)) return &vc->sig;
+  if (auto* nv = std::get_if<NewView>(&m)) return &nv->sig;
   return nullptr;
+}
+ClientRequest null_request() {
+  // Sequence-gap filler in a new view (PBFT §4.4): goes through the
+  // protocol like any request; its execution is a no-op.
+  ClientRequest r;
+  r.operation = "<null>";
+  r.timestamp = 0;
+  r.client = "<null>";
+  return r;
 }
 }  // namespace
 
@@ -160,11 +173,14 @@ Actions Replica::dispatch(const Message& msg) {
   if (auto* p = std::get_if<Prepare>(&msg)) return on_prepare(*p);
   if (auto* c = std::get_if<Commit>(&msg)) return on_commit(*c);
   if (auto* cp = std::get_if<Checkpoint>(&msg)) return on_checkpoint(*cp);
+  if (auto* vc = std::get_if<ViewChange>(&msg)) return on_view_change(*vc);
+  if (auto* nv = std::get_if<NewView>(&msg)) return on_new_view(*nv);
   if (auto* r = std::get_if<ClientRequest>(&msg)) return on_client_request(*r);
   return {};
 }
 
 Actions Replica::on_pre_prepare(const PrePrepare& pp) {
+  if (in_view_change_) return {};  // §4.4: only cp/vc/nv accepted
   if (pp.view != view_ || pp.replica != primary()) return {};
   if (pp.request.digest_hex() != pp.digest) return {};
   if (!in_window(pp.seq)) return {};
@@ -193,7 +209,7 @@ Actions Replica::accept_pre_prepare(const PrePrepare& pp) {
 }
 
 Actions Replica::on_prepare(const Prepare& p) {
-  if (p.view != view_ || !in_window(p.seq)) return {};
+  if (in_view_change_ || p.view != view_ || !in_window(p.seq)) return {};
   return insert_prepare(p);
 }
 
@@ -239,7 +255,7 @@ Actions Replica::maybe_commit(const Key& key) {
 }
 
 Actions Replica::on_commit(const Commit& c) {
-  if (c.view != view_ || !in_window(c.seq)) return {};
+  if (in_view_change_ || c.view != view_ || !in_window(c.seq)) return {};
   return insert_commit(c);
 }
 
@@ -285,30 +301,41 @@ Actions Replica::drain_executions() {
     }
     const ClientRequest& req = ppit->second.request;
     executed_upto_ = seq;
-    auto it = last_timestamp_.find(req.client);
-    if (it != last_timestamp_.end() && req.timestamp <= it->second) {
-      counters["duplicate_requests"] += 1;
-      continue;
-    }
-    // Execution: the reference's app is a no-op returning "awesome!"
-    // (reference src/message.rs:70); kept as the built-in app.
-    std::string result = "awesome!";
-    counters["executed"] += 1;
-    {
+    if (req.client == "<null>") {
+      // Null request (view-change gap filler): no-op execution, no reply,
+      // but the sequence and state digest chain still advance.
       std::vector<uint8_t> buf(state_digest_, state_digest_ + 32);
-      buf.insert(buf.end(), result.begin(), result.end());
+      static const char* kNull = "<null>";
+      buf.insert(buf.end(), kNull, kNull + 6);
       for (int i = 7; i >= 0; --i) buf.push_back((uint8_t)(seq >> (8 * i)));
       blake2b_256(state_digest_, buf.data(), buf.size());
+    } else {
+      auto it = last_timestamp_.find(req.client);
+      if (it != last_timestamp_.end() && req.timestamp <= it->second) {
+        counters["duplicate_requests"] += 1;
+      } else {
+        // Execution: the reference's app is a no-op returning "awesome!"
+        // (reference src/message.rs:70); kept as the built-in app.
+        std::string result = "awesome!";
+        counters["executed"] += 1;
+        {
+          std::vector<uint8_t> buf(state_digest_, state_digest_ + 32);
+          buf.insert(buf.end(), result.begin(), result.end());
+          for (int i = 7; i >= 0; --i)
+            buf.push_back((uint8_t)(seq >> (8 * i)));
+          blake2b_256(state_digest_, buf.data(), buf.size());
+        }
+        last_timestamp_[req.client] = req.timestamp;
+        ClientReply reply;
+        reply.view = view;
+        reply.timestamp = req.timestamp;
+        reply.client = req.client;
+        reply.replica = id_;
+        reply.result = result;
+        last_reply_[req.client] = reply;
+        out.replies.push_back({req.client, reply});
+      }
     }
-    last_timestamp_[req.client] = req.timestamp;
-    ClientReply reply;
-    reply.view = view;
-    reply.timestamp = req.timestamp;
-    reply.client = req.client;
-    reply.replica = id_;
-    reply.result = result;
-    last_reply_[req.client] = reply;
-    out.replies.push_back({req.client, reply});
     if (seq % config_.checkpoint_interval == 0) {
       Checkpoint cp;
       cp.seq = seq;
@@ -335,7 +362,14 @@ Actions Replica::insert_checkpoint(const Checkpoint& cp) {
   for (const auto& [rid, c] : slot) by_digest[c.digest] += 1;
   for (const auto& [d, count] : by_digest) {
     if (count >= 2 * config_.f() + 1) {
+      // Keep the 2f+1 matching checkpoint messages: they are the C
+      // component of our next VIEW-CHANGE (PBFT §4.4).
+      JsonArray proof;
+      for (const auto& [rid, c] : slot) {
+        if (c.digest == d) proof.push_back(c.to_json());
+      }
       advance_watermark(cp.seq, d);
+      stable_proof_ = std::move(proof);
       break;
     }
   }
@@ -378,6 +412,309 @@ void Replica::advance_watermark(int64_t stable_seq,
     if (it->first <= stable_seq) it = pending_execution_.erase(it);
     else ++it;
   }
+}
+
+// -- view change (PBFT §4.4) --------------------------------------------
+// Mirrors pbft_tpu/consensus/replica.py. Hot-path signatures are gated
+// through the batched verifier; the evidence nested inside view-change
+// messages (checkpoint certs, prepared certs, the VCs embedded in a
+// NEW-VIEW) is verified inline on the host — view changes are rare
+// reconfiguration events, not the throughput path.
+
+bool Replica::has_unexecuted() const {
+  if (!pending_execution_.empty()) return true;
+  for (const auto& [key, pp] : pre_prepares_) {
+    if (key.second > executed_upto_) return true;
+  }
+  return false;
+}
+
+bool Replica::verify_inline(int64_t rid, const Message& m,
+                            const std::string& sig_hex) const {
+  if (rid < 0 || rid >= config_.n()) return false;
+  uint8_t sig[64], digest[32];
+  if (!from_hex(sig_hex, sig, 64)) return false;
+  message_signable(m, digest);
+  return ed25519_verify(config_.replicas[rid].pubkey, digest, 32, sig);
+}
+
+Actions Replica::start_view_change(int64_t new_view) {
+  int64_t floor = in_view_change_ ? pending_view_ : view_;
+  int64_t v = new_view < 0 ? floor + 1 : new_view;
+  if (v <= floor) return {};
+  in_view_change_ = true;
+  pending_view_ = v;
+  counters["view_changes_started"] += 1;
+  ViewChange vc;
+  vc.new_view = v;
+  vc.last_stable_seq = low_mark_;
+  vc.checkpoint_proof = stable_proof_;
+  vc.prepared_proofs = prepared_proofs();
+  vc.replica = id_;
+  vc = sign(vc);
+  Actions out;
+  out.broadcasts.push_back({Message(vc)});
+  out.merge(on_view_change(vc));  // log our own
+  return out;
+}
+
+JsonArray Replica::prepared_proofs() const {
+  // P: per sequence prepared above the low watermark, the pre-prepare +
+  // its 2f matching backup prepares (highest view wins per sequence).
+  std::map<int64_t, std::pair<int64_t, Json>> best;  // seq -> (view, entry)
+  for (const auto& [key, pp] : pre_prepares_) {
+    auto [view, seq] = key;
+    if (seq <= low_mark_ || !prepared(key)) continue;
+    int64_t prim = config_.primary_of(view);
+    JsonArray preps;
+    auto slot = prepares_.find(key);
+    if (slot != prepares_.end()) {
+      for (const auto& [rid, p] : slot->second) {
+        if (rid != prim && p.digest == pp.digest) preps.push_back(p.to_json());
+      }
+    }
+    JsonObject entry;
+    entry.emplace("pre_prepare", pp.to_json());
+    entry.emplace("prepares", Json(std::move(preps)));
+    auto it = best.find(seq);
+    if (it == best.end() || view > it->second.first) {
+      best[seq] = {view, Json(std::move(entry))};
+    }
+  }
+  JsonArray out;
+  for (auto& [seq, vp] : best) out.push_back(std::move(vp.second));
+  return out;
+}
+
+bool Replica::validate_view_change(const ViewChange& vc) const {
+  // C: 2f+1 checkpoint messages proving last_stable_seq.
+  if (vc.last_stable_seq > 0) {
+    std::set<int64_t> seen;
+    std::map<std::string, int64_t> by_digest;
+    for (const Json& d : vc.checkpoint_proof) {
+      auto m = message_from_json(d);
+      if (!m) return false;
+      auto* cp = std::get_if<Checkpoint>(&*m);
+      if (!cp || cp->seq != vc.last_stable_seq) return false;
+      if (seen.count(cp->replica)) return false;
+      if (!verify_inline(cp->replica, *m, cp->sig)) return false;
+      seen.insert(cp->replica);
+      by_digest[cp->digest] += 1;
+    }
+    int64_t most = 0;
+    for (const auto& [d, c] : by_digest) most = std::max(most, c);
+    if (most < 2 * config_.f() + 1) return false;
+  }
+  // P: each prepared certificate internally consistent + signed.
+  for (const Json& proof : vc.prepared_proofs) {
+    const Json* ppd = proof.find("pre_prepare");
+    const Json* preps = proof.find("prepares");
+    if (!ppd || !preps || !preps->is_array()) return false;
+    auto ppm = message_from_json(*ppd);
+    if (!ppm) return false;
+    auto* pp = std::get_if<PrePrepare>(&*ppm);
+    if (!pp || pp->seq <= vc.last_stable_seq) return false;
+    int64_t prim = config_.primary_of(pp->view);
+    if (pp->replica != prim || pp->request.digest_hex() != pp->digest)
+      return false;
+    if (!verify_inline(prim, *ppm, pp->sig)) return false;
+    std::set<int64_t> seen;
+    for (const Json& pd : preps->as_array()) {
+      auto pm = message_from_json(pd);
+      if (!pm) return false;
+      auto* p = std::get_if<Prepare>(&*pm);
+      if (!p) return false;
+      if (p->view != pp->view || p->seq != pp->seq || p->digest != pp->digest)
+        return false;
+      if (p->replica == prim || seen.count(p->replica)) return false;
+      if (!verify_inline(p->replica, *pm, p->sig)) return false;
+      seen.insert(p->replica);
+    }
+    if ((int64_t)seen.size() < 2 * config_.f()) return false;
+  }
+  return true;
+}
+
+Actions Replica::on_view_change(const ViewChange& vc) {
+  if (vc.new_view <= view_) return {};
+  auto& slot = view_changes_[vc.new_view];
+  if (slot.count(vc.replica)) return {};
+  if (!validate_view_change(vc)) return {};
+  slot.emplace(vc.replica, vc);
+  Actions out;
+  // Join rule (§4.5.2): f+1 replicas already moved past our view -> join
+  // the smallest such view even if our own timer has not fired.
+  int64_t floor = in_view_change_ ? pending_view_ : view_;
+  std::set<int64_t> voters;
+  int64_t smallest = -1;
+  for (const auto& [v, reps] : view_changes_) {
+    if (v > floor) {
+      for (const auto& [rid, _] : reps) voters.insert(rid);
+      if (smallest < 0) smallest = v;
+    }
+  }
+  if ((int64_t)voters.size() >= config_.f() + 1) {
+    out.merge(start_view_change(smallest));
+  }
+  if (config_.primary_of(vc.new_view) == id_) {
+    out.merge(maybe_new_view(vc.new_view));
+  }
+  return out;
+}
+
+std::pair<int64_t, std::vector<Replica::OEntry>> Replica::compute_o(
+    const std::vector<ViewChange>& vcs) const {
+  int64_t min_s = 0;
+  for (const auto& vc : vcs) min_s = std::max(min_s, vc.last_stable_seq);
+  // seq -> (view, digest, request json)
+  std::map<int64_t, std::tuple<int64_t, std::string, Json>> best;
+  for (const auto& vc : vcs) {
+    for (const Json& proof : vc.prepared_proofs) {
+      const Json* ppd = proof.find("pre_prepare");
+      if (!ppd) continue;
+      const Json* seqj = ppd->find("seq");
+      const Json* viewj = ppd->find("view");
+      const Json* digj = ppd->find("digest");
+      const Json* reqj = ppd->find("request");
+      if (!seqj || !viewj || !digj || !reqj) continue;
+      int64_t n = seqj->as_int();
+      if (n <= min_s) continue;
+      auto it = best.find(n);
+      if (it == best.end() || viewj->as_int() > std::get<0>(it->second)) {
+        best[n] = {viewj->as_int(), digj->as_string(), *reqj};
+      }
+    }
+  }
+  std::vector<OEntry> entries;
+  int64_t max_s = best.empty() ? min_s : best.rbegin()->first;
+  for (int64_t n = min_s + 1; n <= max_s; ++n) {
+    auto it = best.find(n);
+    if (it != best.end()) {
+      ClientRequest req;
+      const Json& rj = std::get<2>(it->second);
+      ClientRequest parsed;
+      if (rj.is_object() && rj.find("operation") && rj.find("timestamp") &&
+          rj.find("client")) {
+        parsed.operation = rj.find("operation")->as_string();
+        parsed.timestamp = rj.find("timestamp")->as_int();
+        parsed.client = rj.find("client")->as_string();
+      }
+      entries.push_back({n, std::get<1>(it->second), parsed});
+    } else {
+      entries.push_back({n, null_request().digest_hex(), std::nullopt});
+    }
+  }
+  return {min_s, entries};
+}
+
+namespace {
+const std::string* stable_digest_for(const std::vector<ViewChange>& vcs,
+                                     int64_t min_s) {
+  for (const auto& vc : vcs) {
+    if (vc.last_stable_seq == min_s && !vc.checkpoint_proof.empty()) {
+      const Json* d = vc.checkpoint_proof.front().find("digest");
+      if (d && d->is_string()) return &d->as_string();
+    }
+  }
+  return nullptr;
+}
+}  // namespace
+
+Actions Replica::maybe_new_view(int64_t v) {
+  if (new_view_sent_.count(v)) return {};
+  auto it = view_changes_.find(v);
+  if (it == view_changes_.end() ||
+      (int64_t)it->second.size() < 2 * config_.f() + 1)
+    return {};
+  // Deterministic V: the 2f+1 lowest replica ids (std::map iterates sorted).
+  std::vector<ViewChange> vcs;
+  for (const auto& [rid, vc] : it->second) {
+    if ((int64_t)vcs.size() >= 2 * config_.f() + 1) break;
+    vcs.push_back(vc);
+  }
+  auto [min_s, entries] = compute_o(vcs);
+  std::vector<PrePrepare> pps;
+  for (const auto& e : entries) {
+    PrePrepare pp;
+    pp.view = v;
+    pp.seq = e.seq;
+    pp.digest = e.digest;
+    pp.request = e.request ? *e.request : null_request();
+    pp.replica = id_;
+    pps.push_back(sign(pp));
+  }
+  NewView nv;
+  nv.new_view = v;
+  for (const auto& vc : vcs) nv.view_changes.push_back(vc.to_json());
+  for (const auto& pp : pps) nv.pre_prepares.push_back(pp.to_json());
+  nv.replica = id_;
+  nv = sign(nv);
+  new_view_sent_.insert(v);
+  Actions out;
+  out.broadcasts.push_back({Message(nv)});
+  out.merge(enter_new_view(v, min_s, stable_digest_for(vcs, min_s), pps));
+  return out;
+}
+
+Actions Replica::on_new_view(const NewView& nv) {
+  if (nv.new_view < view_ || (nv.new_view == view_ && !in_view_change_))
+    return {};
+  if (nv.replica != config_.primary_of(nv.new_view)) return {};
+  std::vector<ViewChange> vcs;
+  std::set<int64_t> seen;
+  for (const Json& d : nv.view_changes) {
+    auto m = message_from_json(d);
+    if (!m) return {};
+    auto* vc = std::get_if<ViewChange>(&*m);
+    if (!vc || vc->new_view != nv.new_view) return {};
+    if (seen.count(vc->replica)) return {};
+    if (!verify_inline(vc->replica, *m, vc->sig)) return {};
+    if (!validate_view_change(*vc)) return {};
+    seen.insert(vc->replica);
+    vcs.push_back(*vc);
+  }
+  if ((int64_t)vcs.size() < 2 * config_.f() + 1) return {};
+  // O must equal our own recomputation from V (a Byzantine new primary
+  // cannot smuggle in requests nobody prepared).
+  auto [min_s, entries] = compute_o(vcs);
+  if (nv.pre_prepares.size() != entries.size()) return {};
+  std::vector<PrePrepare> pps;
+  for (size_t i = 0; i < entries.size(); ++i) {
+    auto m = message_from_json(nv.pre_prepares[i]);
+    if (!m) return {};
+    auto* pp = std::get_if<PrePrepare>(&*m);
+    if (!pp) return {};
+    if (pp->view != nv.new_view || pp->seq != entries[i].seq ||
+        pp->digest != entries[i].digest || pp->replica != nv.replica)
+      return {};
+    if (pp->request.digest_hex() != pp->digest) return {};
+    if (!verify_inline(pp->replica, *m, pp->sig)) return {};
+    pps.push_back(*pp);
+  }
+  return enter_new_view(nv.new_view, min_s, stable_digest_for(vcs, min_s),
+                        pps);
+}
+
+Actions Replica::enter_new_view(int64_t v, int64_t min_s,
+                                const std::string* stable_digest,
+                                const std::vector<PrePrepare>& pps) {
+  view_ = v;
+  in_view_change_ = false;
+  pending_view_ = 0;
+  counters["view_changes_completed"] += 1;
+  for (auto it = view_changes_.begin(); it != view_changes_.end();) {
+    if (it->first <= v) it = view_changes_.erase(it);
+    else ++it;
+  }
+  if (min_s > low_mark_ && stable_digest) {
+    advance_watermark(min_s, *stable_digest);
+  }
+  // The new primary continues the sequence after the re-issued slots.
+  seq_counter_ = min_s;
+  for (const auto& pp : pps) seq_counter_ = std::max(seq_counter_, pp.seq);
+  Actions out;
+  for (const auto& pp : pps) out.merge(on_pre_prepare(pp));
+  return out;
 }
 
 }  // namespace pbft

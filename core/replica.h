@@ -83,6 +83,15 @@ class Replica {
   std::vector<VerifyItem> pending_items() const;
   Actions deliver_verdicts(const std::vector<uint8_t>& verdicts);
 
+  // View change (PBFT §4.4): called by the runtime when its request timer
+  // for the current primary expires. new_view < 0 means "next view".
+  Actions start_view_change(int64_t new_view = -1);
+  bool in_view_change() const { return in_view_change_; }
+  int64_t view() const { return view_; }
+  // True when accepted pre-prepares (or committed-but-unexecuted slots)
+  // sit above executed_upto — the net layer's request-timer signal.
+  bool has_unexecuted() const;
+
   // Metrics (SURVEY.md §5: first-class counters, not printf).
   std::map<std::string, int64_t> counters;
 
@@ -105,6 +114,26 @@ class Replica {
   Actions on_checkpoint(const Checkpoint& cp);
   Actions insert_checkpoint(const Checkpoint& cp);
   void advance_watermark(int64_t stable_seq, const std::string& stable_digest);
+
+  // View change internals (mirrors pbft_tpu/consensus/replica.py; hot-path
+  // signatures are batch-verified, rare view-change evidence inline).
+  struct OEntry {
+    int64_t seq;
+    std::string digest;
+    std::optional<ClientRequest> request;  // nullopt -> null request
+  };
+  bool verify_inline(int64_t rid, const Message& m,
+                     const std::string& sig_hex) const;
+  bool validate_view_change(const ViewChange& vc) const;
+  Actions on_view_change(const ViewChange& vc);
+  Actions on_new_view(const NewView& nv);
+  Actions maybe_new_view(int64_t v);
+  Actions enter_new_view(int64_t v, int64_t min_s,
+                         const std::string* stable_digest,
+                         const std::vector<PrePrepare>& pps);
+  JsonArray prepared_proofs() const;
+  std::pair<int64_t, std::vector<OEntry>> compute_o(
+      const std::vector<ViewChange>& vcs) const;
   bool prepared(const Key& key) const;
   bool committed_local(const Key& key) const;
   bool in_window(int64_t seq) const {
@@ -129,6 +158,12 @@ class Replica {
   std::map<std::string, ClientReply> last_reply_;
   std::map<int64_t, std::map<int64_t, Checkpoint>> checkpoints_;
   std::deque<Message> inbox_;
+
+  bool in_view_change_ = false;
+  int64_t pending_view_ = 0;
+  std::map<int64_t, std::map<int64_t, ViewChange>> view_changes_;
+  std::set<int64_t> new_view_sent_;
+  JsonArray stable_proof_;  // 2f+1 checkpoint dicts @ low_mark (C)
 };
 
 }  // namespace pbft

@@ -79,6 +79,46 @@ class PbftClient:
             s.sendall(req.canonical() + b"\n")
         return req
 
+    def request_with_retry(
+        self,
+        operation: str,
+        timeout: float = 20.0,
+        retry_every: float = 2.0,
+    ) -> str:
+        """The paper's client liveness rule: send to the primary; if no
+        f+1 reply quorum before the retransmission timer, broadcast to ALL
+        replicas (forcing forwards + eventually a view change on a faulty
+        primary) and keep retrying until the deadline."""
+        import time as _time
+
+        self._timestamp += 1
+        ts = self._timestamp
+        req = ClientRequest(operation=operation, timestamp=ts, client=self.address)
+        payload = req.canonical() + b"\n"
+
+        def send_to(rid: int) -> None:
+            ident = self.config.identity(rid)
+            try:
+                with socket.create_connection(
+                    (ident.host, ident.port), timeout=2
+                ) as s:
+                    s.sendall(payload)
+            except OSError:
+                pass  # dead replica: that's what the broadcast is for
+
+        send_to(0)
+        deadline = _time.monotonic() + timeout
+        while True:
+            try:
+                return self.wait_result(
+                    ts, timeout=min(retry_every, max(0.1, deadline - _time.monotonic()))
+                )
+            except TimeoutError:
+                if _time.monotonic() >= deadline:
+                    raise
+                for rid in range(self.config.n):
+                    send_to(rid)
+
     def wait_result(
         self, timestamp: int, f: Optional[int] = None, timeout: float = 10.0
     ) -> str:

@@ -44,6 +44,7 @@ class LocalCluster:
         n: int = 4,
         verifier: str = "cpu",
         metrics_every: int = 0,
+        vc_timeout_ms: int = 0,
         config: Optional[ClusterConfig] = None,
         seeds: Optional[List[bytes]] = None,
     ):
@@ -64,6 +65,7 @@ class LocalCluster:
         self.seeds = seeds
         self.verifier = verifier
         self.metrics_every = metrics_every
+        self.vc_timeout_ms = vc_timeout_ms
         self.procs: List[subprocess.Popen] = []
         self.tmpdir: Optional[tempfile.TemporaryDirectory] = None
 
@@ -87,6 +89,8 @@ class LocalCluster:
             ]
             if self.metrics_every:
                 cmd += ["--metrics-every", str(self.metrics_every)]
+            if self.vc_timeout_ms:
+                cmd += ["--vc-timeout-ms", str(self.vc_timeout_ms)]
             self.procs.append(
                 subprocess.Popen(cmd, stdout=log, stderr=log, close_fds=True)
             )

@@ -62,6 +62,26 @@ def test_many_requests_pipeline():
             client.close()
 
 
+def test_view_change_on_primary_crash():
+    """Kill the primary: backups' request timers fire, a view change
+    elects replica 1, and the client's retransmission commits in view 1
+    (PBFT §4.4-§4.5; the reference had no view change at all, reference
+    src/view.rs:1-13)."""
+    with LocalCluster(n=4, verifier="cpu", vc_timeout_ms=500) as cluster:
+        client = PbftClient(cluster.config)
+        try:
+            # Sanity commit in view 0.
+            req = client.request("warmup")
+            assert client.wait_result(req.timestamp, timeout=15) == "awesome!"
+            cluster.kill(0)
+            result = client.request_with_retry(
+                "post-crash", timeout=30, retry_every=1.0
+            )
+            assert result == "awesome!"
+        finally:
+            client.close()
+
+
 def test_remote_verifier_service_path():
     """pbftd -> RemoteVerifier -> Python VerifierService over TCP: the same
     socket protocol the TPU service uses (cpu backend keeps the test light;

@@ -12,8 +12,10 @@ from pbft_tpu.consensus.messages import (
     ClientReply,
     ClientRequest,
     Commit,
+    NewView,
     Prepare,
     PrePrepare,
+    ViewChange,
 )
 
 pytestmark = pytest.mark.skipif(
@@ -34,6 +36,19 @@ REQ = ClientRequest(
     operation='héllo ☃ "q" \\s\n\t\x01 \U0001f600', timestamp=1 << 40,
     client="127.0.0.1:9000",
 )
+_PP = PrePrepare(view=0, seq=17, digest=REQ.digest(), request=REQ, replica=0, sig="ab" * 64)
+_PREP = Prepare(view=0, seq=17, digest=REQ.digest(), replica=2, sig="cd" * 64)
+_CP = Checkpoint(seq=16, digest="11" * 32, replica=1, sig="22" * 64)
+_VC = ViewChange(
+    new_view=1,
+    last_stable_seq=16,
+    checkpoint_proof=(_CP.to_dict(),),
+    prepared_proofs=(
+        {"pre_prepare": _PP.to_dict(), "prepares": [_PREP.to_dict()]},
+    ),
+    replica=2,
+    sig="33" * 64,
+)
 MESSAGES = [
     REQ,
     ClientReply(view=0, timestamp=1, client="c:1", replica=3, result="awesome!"),
@@ -41,6 +56,14 @@ MESSAGES = [
     Prepare(view=1, seq=2, digest="dd" * 32, replica=2, sig="cd" * 64),
     Commit(view=1, seq=2, digest="dd" * 32, replica=2, sig="ef" * 64),
     Checkpoint(seq=16, digest="11" * 32, replica=1, sig="22" * 64),
+    _VC,
+    NewView(
+        new_view=1,
+        view_changes=(_VC.to_dict(),),
+        pre_prepares=(_PP.to_dict(),),
+        replica=1,
+        sig="44" * 64,
+    ),
 ]
 
 
