@@ -137,6 +137,14 @@ class Replica:
     def high_mark(self) -> int:
         return self.low_mark + self.config.watermark_window
 
+    def has_unexecuted(self) -> bool:
+        """True when accepted pre-prepares (or committed-but-unexecuted
+        slots) sit above executed_upto — the runtime's request-timer
+        signal (mirrors core/replica.cc)."""
+        if self.pending_execution:
+            return True
+        return any(seq > self.executed_upto for _, seq in self.pre_prepares)
+
     def _sign(self, msg: Message) -> Message:
         return with_sig(msg, crypto.sign(self._seed, msg.signable()).hex())
 

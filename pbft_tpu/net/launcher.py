@@ -37,7 +37,13 @@ def free_ports(n: int) -> List[int]:
 
 
 class LocalCluster:
-    """N pbftd processes on loopback ephemeral ports."""
+    """N replica processes on loopback ephemeral ports.
+
+    ``impl`` selects the runtime per replica: "cxx" spawns the native
+    pbftd daemon, "py" spawns the asyncio runtime
+    (python -m pbft_tpu.net.server). The two are wire-compatible (framed
+    canonical JSON), so mixed clusters interoperate — the strongest form
+    of the cross-implementation determinism requirement (SURVEY.md §7)."""
 
     def __init__(
         self,
@@ -45,6 +51,7 @@ class LocalCluster:
         verifier: str = "cpu",
         metrics_every: int = 0,
         vc_timeout_ms: int = 0,
+        impl: "str | List[str]" = "cxx",
         config: Optional[ClusterConfig] = None,
         seeds: Optional[List[bytes]] = None,
     ):
@@ -66,18 +73,31 @@ class LocalCluster:
         self.verifier = verifier
         self.metrics_every = metrics_every
         self.vc_timeout_ms = vc_timeout_ms
+        self.impl = [impl] * self.config.n if isinstance(impl, str) else list(impl)
         self.procs: List[subprocess.Popen] = []
         self.tmpdir: Optional[tempfile.TemporaryDirectory] = None
 
     def __enter__(self) -> "LocalCluster":
-        daemon = pbftd_path()
+        import sys
+
+        daemon = pbftd_path() if "cxx" in self.impl else None
         self.tmpdir = tempfile.TemporaryDirectory(prefix="pbftd-")
         cfg_path = Path(self.tmpdir.name) / "network.json"
         cfg_path.write_text(self.config.to_json())
+        repo_root = str(Path(__file__).resolve().parent.parent.parent)
         for i in range(self.config.n):
             log = open(Path(self.tmpdir.name) / f"replica-{i}.log", "wb")
-            cmd = [
-                str(daemon),
+            if self.impl[i] == "cxx":
+                cmd = [str(daemon)]
+                env = None
+            else:
+                cmd = [sys.executable, "-m", "pbft_tpu.net.server"]
+                env = dict(os.environ, PYTHONPATH=repo_root)
+                if self.verifier != "jax":
+                    # Keep a cpu-verifier replica from initializing any
+                    # accelerator backend at import time.
+                    env["JAX_PLATFORMS"] = "cpu"
+            cmd += [
                 "--config",
                 str(cfg_path),
                 "--id",
@@ -92,12 +112,14 @@ class LocalCluster:
             if self.vc_timeout_ms:
                 cmd += ["--vc-timeout-ms", str(self.vc_timeout_ms)]
             self.procs.append(
-                subprocess.Popen(cmd, stdout=log, stderr=log, close_fds=True)
+                subprocess.Popen(
+                    cmd, stdout=log, stderr=log, close_fds=True, env=env
+                )
             )
         self._wait_listening()
         return self
 
-    def _wait_listening(self, timeout: float = 10.0) -> None:
+    def _wait_listening(self, timeout: float = 30.0) -> None:
         deadline = time.monotonic() + timeout
         for ident in self.config.replicas:
             while True:

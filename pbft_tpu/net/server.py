@@ -1,0 +1,318 @@
+"""The TPU-native replica runtime: asyncio event loop + in-process JAX verifier.
+
+Two deployment shapes ship with this framework (SURVEY.md §5):
+
+1. ``pbftd`` (C++, core/net.cc) — the native daemon; its ``tpu`` verifier
+   ships batches over a socket to the colocated VerifierService.
+2. This module — replicas ARE the JAX process, so signature batches never
+   cross a process boundary: the event loop drains every socket, then runs
+   ONE batched XLA launch over everything that arrived (the batching
+   window), then emits the resulting protocol messages.
+
+Wire-compatible with pbftd: framed canonical JSON between replicas, raw
+JSON with dial-back replies for clients (the reference's client contract,
+reference src/client_handler.rs:75-84). A pbftd cluster and an
+AsyncReplicaServer cluster interoperate — the encodings are byte-identical
+(tests/test_native_messages.py).
+
+Run one replica:  python -m pbft_tpu.net.server --config network.json \
+                      --id 0 --seed <64-hex> [--verifier cpu|jax]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..consensus.config import ClusterConfig
+from ..consensus.messages import (
+    ClientReply,
+    ClientRequest,
+    Message,
+    from_wire,
+)
+from ..consensus.replica import Broadcast, Replica, Reply, Send
+
+
+def _frame(msg: Message) -> bytes:
+    payload = msg.canonical()
+    return len(payload).to_bytes(4, "big") + payload
+
+
+class AsyncReplicaServer:
+    def __init__(
+        self,
+        config: ClusterConfig,
+        replica_id: int,
+        seed: bytes,
+        verifier: Callable | str = "cpu",
+        vc_timeout: float = 0.0,
+    ):
+        self.config = config
+        self.id = replica_id
+        self.replica = Replica(config, replica_id, seed)
+        if callable(verifier):
+            self.verify = verifier
+        elif verifier == "jax":
+            from ..crypto import batch
+
+            self.verify = batch.verify_many
+        else:
+            from ..crypto import ref
+
+            self.verify = lambda items: [
+                ref.verify(p, m, s) for p, m, s in items
+            ]
+        self.vc_timeout = vc_timeout
+        self._server: Optional[asyncio.Server] = None
+        self._peer_writers: Dict[int, asyncio.StreamWriter] = {}
+        self._batch_wakeup = asyncio.Event()
+        self._stopping = False
+        self.listen_port = 0
+        self.batches_run = 0
+        self.frames_in = 0
+        # Progress timer state (mirrors core/net.cc check_progress_timer).
+        self._waiting_requests: Dict[Tuple[str, int], float] = {}
+        self._timer_deadline: Optional[float] = None
+        self._timer_snapshot = (0, 0)  # (executed_upto, view)
+        self._timer_backoff = 1
+
+    # -- lifecycle ----------------------------------------------------------
+
+    async def start(self) -> "AsyncReplicaServer":
+        ident = self.config.identity(self.id)
+        self._server = await asyncio.start_server(
+            self._on_connection, host="0.0.0.0", port=ident.port
+        )
+        self.listen_port = self._server.sockets[0].getsockname()[1]
+        asyncio.get_running_loop().create_task(self._batch_pump())
+        if self.vc_timeout > 0:
+            asyncio.get_running_loop().create_task(self._timer_loop())
+        return self
+
+    async def stop(self) -> None:
+        self._stopping = True
+        self._batch_wakeup.set()
+        if self._server:
+            self._server.close()
+            await self._server.wait_closed()
+        for w in self._peer_writers.values():
+            w.close()
+
+    # -- inbound ------------------------------------------------------------
+
+    async def _on_connection(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        try:
+            first = await reader.read(1)
+            if not first:
+                return
+            if first == b"{":
+                await self._client_connection(first, reader)
+            else:
+                await self._peer_connection(first, reader)
+        except (ConnectionError, asyncio.IncompleteReadError):
+            pass
+        finally:
+            writer.close()
+
+    async def _client_connection(self, first: bytes, reader) -> None:
+        # Raw JSON, one message per line / per connection (telnet-able,
+        # like the reference's gateway).
+        data = first + await reader.read(65536)
+        for line in data.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                msg = from_wire(line)
+            except (ValueError, KeyError, json.JSONDecodeError):
+                continue
+            self._ingest(msg)
+
+    async def _peer_connection(self, first: bytes, reader) -> None:
+        buf = first
+        while True:
+            while len(buf) < 4:
+                chunk = await reader.read(65536)
+                if not chunk:
+                    return
+                buf += chunk
+            n = int.from_bytes(buf[:4], "big")
+            if n > (1 << 24):
+                return  # corrupt frame
+            while len(buf) < 4 + n:
+                chunk = await reader.read(65536)
+                if not chunk:
+                    return
+                buf += chunk
+            payload, buf = buf[4 : 4 + n], buf[4 + n :]
+            try:
+                msg = from_wire(payload)
+            except (ValueError, KeyError, json.JSONDecodeError):
+                continue
+            self._ingest(msg)
+
+    def _ingest(self, msg: Message) -> None:
+        self.frames_in += 1
+        actions = self.replica.receive(msg)
+        if actions:
+            self._emit(actions)
+        self._batch_wakeup.set()
+
+    # -- the batching window -------------------------------------------------
+
+    async def _batch_pump(self) -> None:
+        """Drain -> one batched verify (one XLA launch) -> emit, forever."""
+        loop = asyncio.get_running_loop()
+        while not self._stopping:
+            await self._batch_wakeup.wait()
+            self._batch_wakeup.clear()
+            items = self.replica.pending_items()
+            if not items:
+                continue
+            self.batches_run += 1
+            # The JAX call blocks; run it off the event loop so sockets
+            # keep draining into the next batch meanwhile.
+            verdicts = await loop.run_in_executor(None, self.verify, items)
+            self._emit(self.replica.deliver_verdicts(verdicts))
+
+    # -- outbound ------------------------------------------------------------
+
+    def _emit(self, actions: List) -> None:
+        loop = asyncio.get_running_loop()
+        for act in actions:
+            if isinstance(act, Broadcast):
+                for dest in range(self.config.n):
+                    if dest != self.id:
+                        loop.create_task(self._send_to(dest, act.msg))
+            elif isinstance(act, Send):
+                if isinstance(act.msg, ClientRequest) and self.vc_timeout > 0:
+                    self._waiting_requests[
+                        (act.msg.client, act.msg.timestamp)
+                    ] = time.monotonic() + self.vc_timeout
+                if act.dest == self.id:
+                    self._ingest(act.msg)
+                else:
+                    loop.create_task(self._send_to(act.dest, act.msg))
+            elif isinstance(act, Reply):
+                self._waiting_requests.pop(
+                    (act.msg.client, act.msg.timestamp), None
+                )
+                loop.create_task(self._dial_reply(act.client, act.msg))
+
+    async def _send_to(self, dest: int, msg: Message) -> None:
+        writer = self._peer_writers.get(dest)
+        if writer is None or writer.is_closing():
+            ident = self.config.identity(dest)
+            try:
+                _, writer = await asyncio.open_connection(ident.host, ident.port)
+            except OSError:
+                return  # peer down: PBFT tolerates f of these
+            self._peer_writers[dest] = writer
+        try:
+            writer.write(_frame(msg))
+            await writer.drain()
+        except (ConnectionError, OSError):
+            self._peer_writers.pop(dest, None)
+
+    async def _dial_reply(self, client_addr: str, reply: ClientReply) -> None:
+        host, _, port = client_addr.rpartition(":")
+        try:
+            _, writer = await asyncio.open_connection(host, int(port))
+            writer.write(reply.canonical() + b"\n")
+            await writer.drain()
+            writer.close()
+        except (OSError, ValueError):
+            pass  # client gone
+
+    # -- request/progress timer (PBFT §4.4 liveness) -------------------------
+
+    async def _timer_loop(self) -> None:
+        while not self._stopping:
+            await asyncio.sleep(self.vc_timeout / 4)
+            now = time.monotonic()
+            for key in [
+                k
+                for k, t in self._waiting_requests.items()
+                if now - t > 10 * self.vc_timeout
+            ]:
+                del self._waiting_requests[key]
+            pending = bool(self._waiting_requests) or self.replica.has_unexecuted()
+            if not pending:
+                self._timer_deadline = None
+                self._timer_backoff = 1
+                continue
+            if self._timer_deadline is None:
+                self._timer_snapshot = (self.replica.executed_upto, self.replica.view)
+                self._timer_deadline = now + self.vc_timeout * self._timer_backoff
+                continue
+            if now < self._timer_deadline:
+                continue
+            exec_snap, view_snap = self._timer_snapshot
+            if (
+                self.replica.executed_upto > exec_snap
+                or self.replica.view > view_snap
+            ):
+                self._timer_backoff = 1
+            else:
+                self._timer_backoff = min(self._timer_backoff * 2, 64)
+                self._emit(self.replica.start_view_change())
+            self._timer_deadline = None
+
+    def metrics(self) -> dict:
+        return {
+            "replica": self.id,
+            "port": self.listen_port,
+            "frames_in": self.frames_in,
+            "verify_batches": self.batches_run,
+            "executed_upto": self.replica.executed_upto,
+            "low_mark": self.replica.low_mark,
+            "view": self.replica.view,
+            "in_view_change": self.replica.in_view_change,
+            **self.replica.counters,
+        }
+
+
+async def _amain(args) -> None:
+    config = ClusterConfig.from_json(open(args.config).read())
+    server = AsyncReplicaServer(
+        config,
+        args.id,
+        bytes.fromhex(args.seed),
+        verifier=args.verifier,
+        vc_timeout=args.vc_timeout_ms / 1000.0,
+    )
+    await server.start()
+    print(
+        f"async replica {args.id} listening on {server.listen_port} "
+        f"(verifier={args.verifier})",
+        flush=True,
+    )
+    while True:
+        await asyncio.sleep(args.metrics_every or 3600)
+        if args.metrics_every:
+            print(json.dumps(server.metrics()), flush=True)
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--config", required=True)
+    parser.add_argument("--id", type=int, required=True)
+    parser.add_argument("--seed", required=True, help="64-hex Ed25519 seed")
+    # "jax" = in-process XLA batch verifier; anything else = host oracle
+    # (a "host:port" passed by a shared launcher config falls back to cpu —
+    # this runtime needs no remote service, the TPU path is in-process).
+    parser.add_argument("--verifier", default="cpu")
+    parser.add_argument("--vc-timeout-ms", type=int, default=0)
+    parser.add_argument("--metrics-every", type=int, default=0)
+    args = parser.parse_args()
+    asyncio.run(_amain(args))
+
+
+if __name__ == "__main__":
+    main()

@@ -82,6 +82,34 @@ def test_view_change_on_primary_crash():
             client.close()
 
 
+def test_python_asyncio_runtime_cluster():
+    """The asyncio runtime (in-process verifier) commits end to end."""
+    with LocalCluster(n=4, verifier="cpu", impl="py") as cluster:
+        client = PbftClient(cluster.config)
+        try:
+            req = client.request("async runtime")
+            assert client.wait_result(req.timestamp, timeout=20) == "awesome!"
+        finally:
+            client.close()
+
+
+def test_mixed_cxx_python_cluster_interoperates():
+    """2 pbftd + 2 asyncio replicas in ONE cluster: byte-identical
+    canonical encoding and digests mean the implementations reach
+    consensus together (SURVEY.md §7 'determinism at the FFI boundary',
+    upgraded to cross-runtime determinism)."""
+    with LocalCluster(
+        n=4, verifier="cpu", impl=["cxx", "py", "cxx", "py"]
+    ) as cluster:
+        client = PbftClient(cluster.config)
+        try:
+            reqs = [client.request(f"mixed-{i}") for i in range(3)]
+            for r in reqs:
+                assert client.wait_result(r.timestamp, timeout=25) == "awesome!"
+        finally:
+            client.close()
+
+
 def test_remote_verifier_service_path():
     """pbftd -> RemoteVerifier -> Python VerifierService over TCP: the same
     socket protocol the TPU service uses (cpu backend keeps the test light;
