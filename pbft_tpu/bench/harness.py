@@ -1,0 +1,169 @@
+"""Consensus throughput harness: BASELINE.json configs 1-5, both verifier arms.
+
+Measures sustained consensus rounds/sec and signature verifications/sec
+through the deterministic replica cores wired by the in-memory transport
+(pbft_tpu.consensus.simulation) — the protocol-layer complement to the
+repo-root bench.py kernel benchmark.
+
+Verifier arms:
+- "cpu":   the native C++ batch verifier (core/ed25519.cc via ctypes) —
+           the control arm (falls back to the Python oracle if unbuilt).
+- "jax":   the batched XLA kernel (one launch per batching window).
+
+Usage: python -m pbft_tpu.bench.harness [--arm cpu|jax] [--config N] [--out f]
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import time
+from typing import Callable, List, Optional, Tuple
+
+from ..consensus.simulation import Cluster
+
+
+@dataclasses.dataclass
+class BenchResult:
+    config: str
+    replicas: int
+    f: int
+    clients: int
+    requests: int
+    seconds: float
+    rounds_per_sec: float
+    sig_verifies_per_sec: float
+    sig_verifications: int
+    verifier: str
+    byzantine: bool = False
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self))
+
+
+def _verifier(arm: str, batch_pad: int) -> Callable:
+    if arm == "cpu":
+        try:
+            from .. import native
+
+            if native.available():
+                return native.verify_batch
+        except Exception:
+            pass
+        from ..crypto import ref
+
+        return lambda items: [ref.verify(p, m, s) for p, m, s in items]
+    from ..crypto import batch
+
+    def jax_arm(items):
+        out = []
+        for i in range(0, len(items), batch_pad):
+            out.extend(batch.verify_many(items[i : i + batch_pad], pad_to=batch_pad))
+        return out
+
+    return jax_arm
+
+
+CONFIGS = [
+    # (name, n, clients, requests, byzantine)
+    ("readme-demo f=1", 4, 1, 1, False),
+    ("firehose f=1", 4, 1, 200, False),
+    ("f=2 multi-client", 7, 4, 100, False),
+    ("f=5 large-batch", 16, 8, 50, False),
+    ("f=10 byzantine-signer", 31, 8, 12, True),
+]
+
+
+def run_config(
+    index: int,
+    arm: str = "cpu",
+    batch_pad: int = 256,
+    requests: Optional[int] = None,
+) -> BenchResult:
+    name, n, clients, default_requests, byzantine = CONFIGS[index]
+    reqs_total = requests or default_requests
+    cluster = Cluster(n=n, verifier=_verifier(arm, batch_pad))
+    if byzantine:
+        import dataclasses as dc
+
+        def corrupt(src, msg):
+            if src == n - 1 and getattr(msg, "sig", ""):
+                return dc.replace(msg, sig="ff" * 64)
+            return msg
+
+        cluster.outbound_mutator = corrupt
+
+    t0 = time.perf_counter()
+    pending: List[Tuple[int, int]] = []
+    submitted = 0
+    # Pipelined submission: keep `clients` requests in flight (a PBFT
+    # client has one outstanding request at a time, PBFT §4.1).
+    client_ts = {c: 0 for c in range(clients)}
+    inflight: dict = {}
+    executed = 0
+    while executed < reqs_total:
+        for c in range(clients):
+            if c not in inflight and submitted < reqs_total:
+                client_ts[c] += 1
+                r = cluster.submit(
+                    f"op-{submitted}",
+                    client=f"127.0.0.1:{9000 + c}",
+                    timestamp=client_ts[c],
+                )
+                inflight[c] = r.timestamp
+                submitted += 1
+        if not cluster.step():
+            # Quiesced: every in-flight request has either committed or
+            # stalled; check replies.
+            for c, ts in list(inflight.items()):
+                cluster.committed_result(ts)  # raises if not committed
+                del inflight[c]
+                executed += 1
+            if submitted >= reqs_total and not inflight:
+                break
+    elapsed = time.perf_counter() - t0
+    return BenchResult(
+        config=name,
+        replicas=n,
+        f=cluster.config.f,
+        clients=clients,
+        requests=reqs_total,
+        seconds=round(elapsed, 3),
+        rounds_per_sec=round(reqs_total / elapsed, 1),
+        sig_verifies_per_sec=round(cluster.sig_verifications / elapsed, 1),
+        sig_verifications=cluster.sig_verifications,
+        verifier=arm,
+        byzantine=byzantine,
+    )
+
+
+def run_all(arm: str = "cpu", out_path: Optional[str] = None) -> List[BenchResult]:
+    results = []
+    for i in range(len(CONFIGS)):
+        res = run_config(i, arm=arm)
+        print(res.to_json(), flush=True)
+        results.append(res)
+    if out_path:
+        with open(out_path, "w") as fh:
+            for r in results:
+                fh.write(r.to_json() + "\n")
+    return results
+
+
+def main() -> None:
+    import argparse
+
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--arm", default="cpu", choices=["cpu", "jax"])
+    parser.add_argument("--config", type=int, default=None, help="0-4; default all")
+    parser.add_argument("--requests", type=int, default=None)
+    parser.add_argument("--out", default=None)
+    args = parser.parse_args()
+    if args.config is not None:
+        print(run_config(args.config, arm=args.arm, requests=args.requests).to_json())
+    else:
+        run_all(arm=args.arm, out_path=args.out)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,18 @@
+"""The consensus bench harness commits what it claims to measure."""
+
+from pbft_tpu.bench import run_config
+
+
+def test_readme_demo_config():
+    res = run_config(0, arm="cpu")
+    assert res.replicas == 4 and res.f == 1
+    assert res.requests == 1
+    assert res.sig_verifications > 0
+    assert res.rounds_per_sec > 0
+
+
+def test_byzantine_config_still_commits():
+    res = run_config(4, arm="cpu", requests=2)
+    assert res.byzantine
+    assert res.replicas == 31 and res.f == 10
+    assert res.requests == 2
