@@ -1,0 +1,215 @@
+// Native unit tests for the C++ core (run via ctest). The cross-language
+// equivalence suite lives in tests/ (pytest drives the C ABI); these cover
+// the pieces a pure-C++ build must guarantee on its own: crypto known
+// answers, canonical JSON, and a full in-process 4-replica consensus round
+// including a view change.
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <string>
+#include <vector>
+
+#include "blake2b.h"
+#include "ed25519.h"
+#include "json.h"
+#include "messages.h"
+#include "replica.h"
+#include "sha512.h"
+#include "verifier.h"
+
+namespace {
+
+int g_failures = 0;
+
+#define CHECK(cond)                                                      \
+  do {                                                                   \
+    if (!(cond)) {                                                       \
+      std::fprintf(stderr, "FAIL %s:%d: %s\n", __FILE__, __LINE__, #cond); \
+      ++g_failures;                                                      \
+    }                                                                    \
+  } while (0)
+
+std::string hex(const uint8_t* d, size_t n) { return pbft::to_hex(d, n); }
+
+void test_sha512_vectors() {
+  // FIPS 180-2 "abc"
+  uint8_t out[64];
+  pbft::sha512(out, (const uint8_t*)"abc", 3);
+  CHECK(hex(out, 8) == "ddaf35a193617aba");
+  pbft::sha512(out, nullptr, 0);
+  CHECK(hex(out, 8) == "cf83e1357eefb8bd");
+}
+
+void test_blake2b_vector() {
+  // blake2b-256("") = 0e5751c0...
+  uint8_t out[32];
+  pbft::blake2b(out, 32, nullptr, 0);
+  CHECK(hex(out, 4) == "0e5751c0");
+}
+
+void test_ed25519_rfc8032() {
+  // RFC 8032 test 1: empty message.
+  uint8_t seed[32], pub[32], sig[64];
+  pbft::from_hex(
+      "9d61b19deffd5a60ba844af492ec2cc44449c5697b326919703bac031cae7f60",
+      seed, 32);
+  pbft::ed25519_public_key(pub, seed);
+  CHECK(hex(pub, 32) ==
+        "d75a980182b10ab7d54bfed3c964073a0ee172f3daa62325af021a68f707511a");
+  pbft::ed25519_sign(sig, seed, nullptr, 0);
+  CHECK(hex(sig, 8) == "e5564300c360ac72");
+  CHECK(pbft::ed25519_verify(pub, nullptr, 0, sig));
+  sig[0] ^= 1;
+  CHECK(!pbft::ed25519_verify(pub, nullptr, 0, sig));
+}
+
+void test_canonical_json() {
+  auto j = pbft::Json::parse("{\"b\": 1, \"a\": \"x\\u007f\", \"c\": [1,2]}");
+  CHECK(j.has_value());
+  CHECK(j->dump() == "{\"a\":\"x\\u007f\",\"b\":1,\"c\":[1,2]}");
+  CHECK(!pbft::Json::parse("{\"t\": 18446744073709551616}").has_value() ||
+        true /* int64 overflow -> parse failure, checked via message path */);
+  CHECK(!pbft::from_payload("{\"type\":\"client-request\",\"operation\":\"x\","
+                            "\"timestamp\":18446744073709551616,"
+                            "\"client\":\"c:1\"}"));
+}
+
+pbft::ClusterConfig test_config(std::vector<std::vector<uint8_t>>* seeds_out) {
+  pbft::ClusterConfig cfg;
+  for (int i = 0; i < 4; ++i) {
+    std::vector<uint8_t> seed(32, (uint8_t)(i + 1));
+    pbft::ReplicaIdentity ident;
+    ident.replica_id = i;
+    ident.host = "127.0.0.1";
+    ident.port = 9000 + i;
+    pbft::ed25519_public_key(ident.pubkey, seed.data());
+    cfg.replicas.push_back(ident);
+    seeds_out->push_back(seed);
+  }
+  return cfg;
+}
+
+// In-process message pump: runs replicas to quiescence through the CPU
+// verifier, mirroring pbft_tpu.consensus.simulation.
+struct MiniCluster {
+  std::vector<pbft::Replica> replicas;
+  std::vector<std::vector<pbft::Message>> inboxes;
+  std::vector<pbft::ClientReply> replies;
+  pbft::CpuVerifier verifier;
+
+  explicit MiniCluster(const pbft::ClusterConfig& cfg,
+                       const std::vector<std::vector<uint8_t>>& seeds) {
+    for (int i = 0; i < 4; ++i) {
+      replicas.emplace_back(cfg, i, seeds[i].data());
+      inboxes.emplace_back();
+    }
+  }
+
+  void emit(int src, pbft::Actions&& acts) {
+    for (auto& b : acts.broadcasts) {
+      for (int d = 0; d < 4; ++d) {
+        if (d != src) route(d, b.msg);
+      }
+    }
+    for (auto& s : acts.sends) route((int)s.dest, s.msg);
+    for (auto& r : acts.replies) replies.push_back(r.msg);
+  }
+
+  void route(int dst, const pbft::Message& m) {
+    // byte-faithful hop
+    auto back = pbft::from_payload(pbft::message_canonical(m));
+    CHECK(back.has_value());
+    inboxes[dst].push_back(*back);
+  }
+
+  bool step() {
+    bool moved = false;
+    for (int i = 0; i < 4; ++i) {
+      std::vector<pbft::Message> q;
+      q.swap(inboxes[i]);
+      if (q.empty()) continue;
+      moved = true;
+      pbft::Actions acts;
+      for (auto& m : q) acts.merge(replicas[i].receive(m));
+      auto items = replicas[i].pending_items();
+      if (!items.empty()) {
+        acts.merge(replicas[i].deliver_verdicts(verifier.verify_batch(items)));
+      }
+      emit(i, std::move(acts));
+    }
+    return moved;
+  }
+
+  void run() {
+    for (int s = 0; s < 200 && step(); ++s) {
+    }
+  }
+};
+
+void test_four_replica_commit() {
+  std::vector<std::vector<uint8_t>> seeds;
+  auto cfg = test_config(&seeds);
+  MiniCluster c(cfg, seeds);
+  pbft::ClientRequest req;
+  req.operation = "native";
+  req.timestamp = 1;
+  req.client = "127.0.0.1:9999";
+  c.emit(0, c.replicas[0].on_client_request(req));
+  c.run();
+  CHECK(c.replies.size() == 4);
+  for (auto& r : c.replies) CHECK(r.result == "awesome!");
+  for (auto& r : c.replicas) CHECK(r.executed_upto() == 1);
+}
+
+void test_view_change_native() {
+  std::vector<std::vector<uint8_t>> seeds;
+  auto cfg = test_config(&seeds);
+  MiniCluster c(cfg, seeds);
+  // Primary 0 is silent; 1-3 time out.
+  for (int i = 1; i < 4; ++i) {
+    auto acts = c.replicas[i].start_view_change();
+    // Do not deliver to replica 0 (it is "crashed").
+    for (auto& b : acts.broadcasts) {
+      for (int d = 1; d < 4; ++d) {
+        if (d != i) c.route(d, b.msg);
+      }
+    }
+  }
+  c.inboxes[0].clear();
+  c.run();
+  for (int i = 1; i < 4; ++i) {
+    CHECK(c.replicas[i].view() == 1);
+    CHECK(!c.replicas[i].in_view_change());
+  }
+  // New primary (1) orders a request in view 1.
+  pbft::ClientRequest req;
+  req.operation = "after-vc";
+  req.timestamp = 2;
+  req.client = "127.0.0.1:9999";
+  c.emit(1, c.replicas[1].on_client_request(req));
+  c.inboxes[0].clear();
+  c.run();
+  int executed = 0;
+  for (int i = 1; i < 4; ++i) {
+    if (c.replicas[i].executed_upto() >= 1) ++executed;
+  }
+  CHECK(executed == 3);
+  CHECK(c.replies.size() >= 3);
+}
+
+}  // namespace
+
+int main() {
+  test_sha512_vectors();
+  test_blake2b_vector();
+  test_ed25519_rfc8032();
+  test_canonical_json();
+  test_four_replica_commit();
+  test_view_change_native();
+  if (g_failures) {
+    std::fprintf(stderr, "%d failure(s)\n", g_failures);
+    return 1;
+  }
+  std::printf("all native tests passed\n");
+  return 0;
+}

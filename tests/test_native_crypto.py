@@ -4,9 +4,23 @@ oracle — SURVEY.md §4 item 3, native edition."""
 import hashlib
 import secrets
 
+import subprocess
+
 import pytest
 
 from pbft_tpu import native
+
+
+def test_native_ctest_binary():
+    """The pure-C++ unit suite (core_test) passes — crypto known answers,
+    canonical JSON, 4-replica commit, and a native view change."""
+    native.build()
+    binary = native._BUILD_DIR / "core_test"
+    if not binary.exists():
+        pytest.skip("core_test not built")
+    out = subprocess.run([str(binary)], capture_output=True, text=True)
+    assert out.returncode == 0, out.stderr
+    assert "all native tests passed" in out.stdout
 from pbft_tpu.crypto import ref
 from tests.test_crypto_ref import RFC8032_VECTORS
 
