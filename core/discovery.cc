@@ -1,0 +1,110 @@
+#include "discovery.h"
+
+#include <arpa/inet.h>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstdio>
+#include <cstring>
+
+#include "json.h"
+
+namespace pbft {
+
+Discovery::Discovery(const std::string& target, int64_t replica_id,
+                     int tcp_port)
+    : id_(replica_id), tcp_port_(tcp_port) {
+  auto colon = target.rfind(':');
+  if (colon == std::string::npos) {
+    group_ = target;
+    port_ = 17700;
+  } else {
+    group_ = target.substr(0, colon);
+    port_ = std::atoi(target.c_str() + colon + 1);
+  }
+}
+
+Discovery::~Discovery() {
+  if (recv_fd_ >= 0) close(recv_fd_);
+  if (send_fd_ >= 0) close(send_fd_);
+}
+
+bool Discovery::start() {
+  recv_fd_ = socket(AF_INET, SOCK_DGRAM, 0);
+  if (recv_fd_ < 0) return false;
+  int one = 1;
+  setsockopt(recv_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+#ifdef SO_REUSEPORT
+  setsockopt(recv_fd_, SOL_SOCKET, SO_REUSEPORT, &one, sizeof(one));
+#endif
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons((uint16_t)port_);
+  if (bind(recv_fd_, (sockaddr*)&addr, sizeof(addr)) != 0) return false;
+  ip_mreq mreq{};
+  if (inet_pton(AF_INET, group_.c_str(), &mreq.imr_multiaddr) != 1)
+    return false;
+  mreq.imr_interface.s_addr = htonl(INADDR_LOOPBACK);
+  if (setsockopt(recv_fd_, IPPROTO_IP, IP_ADD_MEMBERSHIP, &mreq,
+                 sizeof(mreq)) != 0) {
+    // Fall back to the default interface (multi-host LAN).
+    mreq.imr_interface.s_addr = htonl(INADDR_ANY);
+    if (setsockopt(recv_fd_, IPPROTO_IP, IP_ADD_MEMBERSHIP, &mreq,
+                   sizeof(mreq)) != 0)
+      return false;
+  }
+  int flags = fcntl(recv_fd_, F_GETFL, 0);
+  fcntl(recv_fd_, F_SETFL, flags | O_NONBLOCK);
+
+  send_fd_ = socket(AF_INET, SOCK_DGRAM, 0);
+  if (send_fd_ < 0) return false;
+  in_addr lo{};
+  lo.s_addr = htonl(INADDR_LOOPBACK);
+  setsockopt(send_fd_, IPPROTO_IP, IP_MULTICAST_IF, &lo, sizeof(lo));
+  int loop = 1;
+  setsockopt(send_fd_, IPPROTO_IP, IP_MULTICAST_LOOP, &loop, sizeof(loop));
+  return true;
+}
+
+void Discovery::announce() {
+  if (send_fd_ < 0) return;
+  JsonObject o;
+  o.emplace("id", Json(id_));
+  o.emplace("port", Json(tcp_port_));
+  std::string beacon = Json(std::move(o)).dump();
+  sockaddr_in dst{};
+  dst.sin_family = AF_INET;
+  dst.sin_port = htons((uint16_t)port_);
+  inet_pton(AF_INET, group_.c_str(), &dst.sin_addr);
+  sendto(send_fd_, beacon.data(), beacon.size(), 0, (sockaddr*)&dst,
+         sizeof(dst));
+}
+
+void Discovery::poll(std::map<int64_t, std::string>* peer_addrs) {
+  if (recv_fd_ < 0) return;
+  char buf[512];
+  sockaddr_in src{};
+  socklen_t slen = sizeof(src);
+  for (;;) {
+    ssize_t r = recvfrom(recv_fd_, buf, sizeof(buf) - 1, 0, (sockaddr*)&src,
+                         &slen);
+    if (r <= 0) return;
+    buf[r] = 0;
+    auto j = Json::parse(std::string(buf, (size_t)r));
+    if (!j) continue;
+    const Json* idj = j->find("id");
+    const Json* portj = j->find("port");
+    if (!idj || !portj) continue;
+    int64_t rid = idj->as_int();
+    if (rid == id_) continue;
+    char host[INET_ADDRSTRLEN];
+    if (!inet_ntop(AF_INET, &src.sin_addr, host, sizeof(host))) continue;
+    (*peer_addrs)[rid] =
+        std::string(host) + ":" + std::to_string((int)portj->as_int());
+  }
+}
+
+}  // namespace pbft

@@ -1,0 +1,39 @@
+// UDP-multicast peer discovery — the rebuild's equivalent of the
+// reference's mDNS layer (reference src/main.rs:46,
+// src/network_behaviour_composer.rs:24-42): replicas periodically beacon
+// {replica_id, tcp_port} to a multicast group and learn each other's
+// addresses, so network.json can list identities (pubkeys) without
+// pinning ports. Like mDNS, discovery is unauthenticated *addressing*
+// only — consensus safety rests on the Ed25519 signatures checked at the
+// protocol layer, so a spoofed beacon can at worst misroute traffic that
+// then fails verification.
+#pragma once
+
+#include <cstdint>
+#include <map>
+#include <string>
+
+namespace pbft {
+
+class Discovery {
+ public:
+  // target: "group:port", e.g. "239.255.77.77:17700".
+  Discovery(const std::string& target, int64_t replica_id, int tcp_port);
+  ~Discovery();
+
+  bool start();  // join the group on loopback + bind; false on error
+  // Send one beacon (call ~1/s).
+  void announce();
+  // Drain received beacons into id -> "host:port".
+  void poll(std::map<int64_t, std::string>* peer_addrs);
+
+ private:
+  std::string group_;
+  int port_ = 0;
+  int64_t id_;
+  int tcp_port_;
+  int recv_fd_ = -1;
+  int send_fd_ = -1;
+};
+
+}  // namespace pbft

@@ -84,6 +84,17 @@ bool ReplicaServer::start() {
   getsockname(listen_fd_, (sockaddr*)&addr, &len);
   listen_port_ = ntohs(addr.sin_port);
   set_nonblocking(listen_fd_);
+  if (!discovery_target_.empty()) {
+    discovery_ =
+        std::make_unique<Discovery>(discovery_target_, id_, listen_port_);
+    if (!discovery_->start()) {
+      std::fprintf(stderr, "replica %lld: discovery on %s failed\n",
+                   (long long)id_, discovery_target_.c_str());
+      discovery_.reset();
+    } else {
+      discovery_->announce();
+    }
+  }
   return true;
 }
 
@@ -119,6 +130,14 @@ void ReplicaServer::poll_once(int timeout_ms) {
   // as one batch (one XLA launch on the TPU backend).
   run_verify_batch();
   check_progress_timer();
+  if (discovery_) {
+    discovery_->poll(&discovered_addrs_);
+    auto now = std::chrono::steady_clock::now();
+    if (now - last_beacon_ > std::chrono::seconds(1)) {
+      discovery_->announce();
+      last_beacon_ = now;
+    }
+  }
   // Drop closed inbound connections.
   conns_.erase(
       std::remove_if(conns_.begin(), conns_.end(),
@@ -316,8 +335,13 @@ int ReplicaServer::peer_fd(int64_t dest) {
   auto it = peers_.find(dest);
   if (it != peers_.end() && !it->second->closed) return it->second->fd;
   const auto& ident = cfg_.replicas[dest];
-  int fd =
-      dial_tcp(ident.host + ":" + std::to_string(ident.port));
+  std::string addr = ident.host + ":" + std::to_string(ident.port);
+  if (ident.port == 0) {  // discovery-addressed peer (mDNS equivalent)
+    auto d = discovered_addrs_.find(dest);
+    if (d == discovered_addrs_.end()) return -1;
+    addr = d->second;
+  }
+  int fd = dial_tcp(addr);
   if (fd < 0) return -1;
   set_nonblocking(fd);
   auto c = std::make_unique<Conn>();

@@ -22,6 +22,7 @@
 #include <string>
 #include <vector>
 
+#include "discovery.h"
 #include "replica.h"
 #include "verifier.h"
 
@@ -64,6 +65,11 @@ class ReplicaServer {
   // (§4.5.2's exponential backoff). 0 disables.
   void set_view_change_timeout(int ms) { vc_timeout_ms_ = ms; }
 
+  // Enable UDP-multicast peer discovery ("group:port") — the mDNS
+  // equivalent: peers whose configured port is 0 are addressed from
+  // beacons instead of network.json. Call before start().
+  void enable_discovery(const std::string& target) { discovery_target_ = target; }
+
  private:
   void accept_ready();
   void handle_readable(Conn& c);
@@ -82,6 +88,10 @@ class ReplicaServer {
   int64_t id_;
   std::unique_ptr<Verifier> verifier_;
   std::unique_ptr<Replica> replica_;
+  std::string discovery_target_;
+  std::unique_ptr<Discovery> discovery_;
+  std::map<int64_t, std::string> discovered_addrs_;
+  std::chrono::steady_clock::time_point last_beacon_{};
   int vc_timeout_ms_ = 0;
   bool timer_armed_ = false;
   int timer_backoff_ = 1;

@@ -52,12 +52,17 @@ class LocalCluster:
         metrics_every: int = 0,
         vc_timeout_ms: int = 0,
         impl: "str | List[str]" = "cxx",
+        discovery: bool = False,
         config: Optional[ClusterConfig] = None,
         seeds: Optional[List[bytes]] = None,
     ):
+        self.discovery = discovery
         if config is None:
             config, seeds = make_local_cluster(n, base_port=0)
-            ports = free_ports(n)
+            # Discovery mode: every replica binds an ephemeral port and
+            # finds peers via multicast beacons (the mDNS-equivalent);
+            # otherwise pre-allocate loopback ports in the config.
+            ports = [0] * n if discovery else free_ports(n)
             config = ClusterConfig(
                 replicas=[
                     type(r)(r.replica_id, r.host, ports[i], r.pubkey)
@@ -78,8 +83,17 @@ class LocalCluster:
         self.tmpdir: Optional[tempfile.TemporaryDirectory] = None
 
     def __enter__(self) -> "LocalCluster":
+        import random
         import sys
 
+        if self.discovery:
+            # Unique group:port per cluster so parallel tests don't hear
+            # each other's beacons.
+            self._discovery_target = "239.255.%d.%d:%d" % (
+                random.randint(1, 254),
+                random.randint(1, 254),
+                free_ports(1)[0],
+            )
         daemon = pbftd_path() if "cxx" in self.impl else None
         self.tmpdir = tempfile.TemporaryDirectory(prefix="pbftd-")
         cfg_path = Path(self.tmpdir.name) / "network.json"
@@ -111,13 +125,50 @@ class LocalCluster:
                 cmd += ["--metrics-every", str(self.metrics_every)]
             if self.vc_timeout_ms:
                 cmd += ["--vc-timeout-ms", str(self.vc_timeout_ms)]
+            if self.discovery:
+                cmd += ["--discovery", self._discovery_target]
             self.procs.append(
                 subprocess.Popen(
                     cmd, stdout=log, stderr=log, close_fds=True, env=env
                 )
             )
+        if self.discovery:
+            self._learn_discovered_ports()
         self._wait_listening()
         return self
+
+    _discovery_target = ""
+
+    def _learn_discovered_ports(self, timeout: float = 20.0) -> None:
+        """Parse each replica's 'listening on N' log line so the *client*
+        knows where to dial; the replicas themselves learn each other
+        from beacons."""
+        import re
+
+        deadline = time.monotonic() + timeout
+        ports: dict = {}
+        while len(ports) < self.config.n:
+            for i in range(self.config.n):
+                if i in ports:
+                    continue
+                log = Path(self.tmpdir.name) / f"replica-{i}.log"
+                if log.exists():
+                    m = re.search(r"listening on (\d+)", log.read_text(errors="replace"))
+                    if m:
+                        ports[i] = int(m.group(1))
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"discovery ports not learned\n{self.logs()}")
+            time.sleep(0.05)
+        self.config = ClusterConfig(
+            replicas=[
+                type(r)(r.replica_id, r.host, ports[i], r.pubkey)
+                for i, r in enumerate(self.config.replicas)
+            ],
+            watermark_window=self.config.watermark_window,
+            checkpoint_interval=self.config.checkpoint_interval,
+            batch_pad=self.config.batch_pad,
+            verifier=self.config.verifier,
+        )
 
     def _wait_listening(self, timeout: float = 30.0) -> None:
         deadline = time.monotonic() + timeout
