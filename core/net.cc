@@ -62,6 +62,7 @@ ReplicaServer::ReplicaServer(ClusterConfig cfg, int64_t id,
 }
 
 ReplicaServer::~ReplicaServer() {
+  if (trace_fp_) std::fclose(trace_fp_);
   if (listen_fd_ >= 0) close(listen_fd_);
   for (auto& c : conns_)
     if (c->fd >= 0) close(c->fd);
@@ -258,11 +259,39 @@ void ReplicaServer::flush(Conn& c) {
   }
 }
 
+void ReplicaServer::set_trace_file(const std::string& path) {
+  trace_fp_ = std::fopen(path.c_str(), "a");
+}
+
+void ReplicaServer::trace(const char* ev, int64_t size, int64_t rejected,
+                          double secs) {
+  if (!trace_fp_) return;
+  auto now = std::chrono::duration<double>(
+                 std::chrono::steady_clock::now().time_since_epoch())
+                 .count();
+  std::fprintf(trace_fp_,
+               "{\"ts\":%.6f,\"ev\":\"%s\",\"replica\":%lld,\"size\":%lld,"
+               "\"rejected\":%lld,\"secs\":%.6f,\"view\":%lld,"
+               "\"executed\":%lld}\n",
+               now, ev, (long long)id_, (long long)size, (long long)rejected,
+               secs, (long long)replica_->view(),
+               (long long)replica_->executed_upto());
+  std::fflush(trace_fp_);
+}
+
 void ReplicaServer::run_verify_batch() {
   auto items = replica_->pending_items();
   if (items.empty()) return;
   ++batches_run_;
+  auto t0 = std::chrono::steady_clock::now();
   auto verdicts = verifier_->verify_batch(items);
+  if (trace_fp_) {
+    int64_t rejected = 0;
+    for (uint8_t v : verdicts) rejected += v ? 0 : 1;
+    trace("verify_batch", (int64_t)items.size(), rejected,
+          std::chrono::duration<double>(std::chrono::steady_clock::now() - t0)
+              .count());
+  }
   emit(replica_->deliver_verdicts(verdicts));
 }
 
@@ -326,6 +355,7 @@ void ReplicaServer::check_progress_timer() {
     // No progress within the timeout: suspect the primary. Exponential
     // backoff keeps cascading view changes from thrashing (§4.5.2).
     timer_backoff_ = std::min(timer_backoff_ * 2, 64);
+    trace("view_change_start", 0, 0, 0.0);
     emit(replica_->start_view_change());
   }
   timer_armed_ = false;  // rearmed on the next tick while work pends

@@ -70,6 +70,10 @@ class ReplicaServer {
   // beacons instead of network.json. Call before start().
   void enable_discovery(const std::string& target) { discovery_target_ = target; }
 
+  // Structured JSONL tracing (batch boundaries + view changes only; the
+  // reference logged inside the poll hot loop, SURVEY.md §5 — we don't).
+  void set_trace_file(const std::string& path);
+
  private:
   void accept_ready();
   void handle_readable(Conn& c);
@@ -88,6 +92,9 @@ class ReplicaServer {
   int64_t id_;
   std::unique_ptr<Verifier> verifier_;
   std::unique_ptr<Replica> replica_;
+  void trace(const char* ev, int64_t size, int64_t rejected, double secs);
+
+  FILE* trace_fp_ = nullptr;
   std::string discovery_target_;
   std::unique_ptr<Discovery> discovery_;
   std::map<int64_t, std::string> discovered_addrs_;

@@ -31,7 +31,7 @@ void on_signal(int) {
 }  // namespace
 
 int main(int argc, char** argv) {
-  std::string config_path, seed_hex, verifier_override, discovery;
+  std::string config_path, seed_hex, verifier_override, discovery, trace_path;
   int64_t id = -1;
   int metrics_every = 0;
   int vc_timeout_ms = 0;
@@ -45,6 +45,7 @@ int main(int argc, char** argv) {
     else if (a == "--metrics-every") metrics_every = std::atoi(next());
     else if (a == "--vc-timeout-ms") vc_timeout_ms = std::atoi(next());
     else if (a == "--discovery") discovery = next();
+    else if (a == "--trace") trace_path = next();
     else {
       std::fprintf(stderr, "unknown arg: %s\n", a.c_str());
       return 2;
@@ -90,6 +91,7 @@ int main(int argc, char** argv) {
   pbft::ReplicaServer server(*cfg, id, seed, std::move(verifier));
   if (vc_timeout_ms > 0) server.set_view_change_timeout(vc_timeout_ms);
   if (!discovery.empty()) server.enable_discovery(discovery);
+  if (!trace_path.empty()) server.set_trace_file(trace_path);
   if (!server.start()) {
     std::fprintf(stderr, "replica %lld: bind failed on port %d\n",
                  (long long)id, cfg->replicas[id].port);

@@ -35,6 +35,7 @@ from ..consensus.messages import (
     from_wire,
 )
 from ..consensus.replica import Broadcast, Replica, Reply, Send
+from ..utils import get_tracer
 
 
 def _frame(msg: Message) -> bytes:
@@ -178,7 +179,19 @@ class AsyncReplicaServer:
             self.batches_run += 1
             # The JAX call blocks; run it off the event loop so sockets
             # keep draining into the next batch meanwhile.
+            t0 = time.monotonic()
             verdicts = await loop.run_in_executor(None, self.verify, items)
+            tracer = get_tracer()
+            if tracer.enabled:  # batch boundaries only — never per message
+                tracer.event(
+                    "verify_batch",
+                    replica=self.id,
+                    size=len(items),
+                    rejected=verdicts.count(False),
+                    secs=round(time.monotonic() - t0, 6),
+                    view=self.replica.view,
+                    executed=self.replica.executed_upto,
+                )
             self._emit(self.replica.deliver_verdicts(verdicts))
 
     # -- outbound ------------------------------------------------------------
@@ -261,6 +274,12 @@ class AsyncReplicaServer:
                 self._timer_backoff = 1
             else:
                 self._timer_backoff = min(self._timer_backoff * 2, 64)
+                get_tracer().event(
+                    "view_change_start",
+                    replica=self.id,
+                    pending_view=self.replica.view + 1,
+                    backoff=self._timer_backoff,
+                )
                 self._emit(self.replica.start_view_change())
             self._timer_deadline = None
 
@@ -310,7 +329,12 @@ def main() -> None:
     parser.add_argument("--verifier", default="cpu")
     parser.add_argument("--vc-timeout-ms", type=int, default=0)
     parser.add_argument("--metrics-every", type=int, default=0)
+    parser.add_argument("--trace", default=None, help="JSONL trace file")
     args = parser.parse_args()
+    if args.trace:
+        from ..utils import set_trace_file
+
+        set_trace_file(args.trace)
     asyncio.run(_amain(args))
 
 

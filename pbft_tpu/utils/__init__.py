@@ -1,0 +1,11 @@
+"""pbft_tpu.utils — structured logging / tracing.
+
+The reference's observability was ~110 println! calls, several inside the
+poll hot loop (SURVEY.md §5 — a real throughput hazard); here tracing is
+structured JSONL events behind a level check, off by default, and never
+in the per-signature hot path (batch boundaries only).
+"""
+
+from .trace import Tracer, get_tracer, set_trace_file
+
+__all__ = ["Tracer", "get_tracer", "set_trace_file"]

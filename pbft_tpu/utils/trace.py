@@ -1,0 +1,48 @@
+"""Structured JSONL tracing for the replica runtimes.
+
+Events are single JSON lines: {"ts": <monotonic>, "ev": <name>, ...fields}.
+Disabled (no-op, one attribute check) unless a sink is set — tracing must
+never tax the batching hot loop the way the reference's println!-in-poll
+did (reference src/handler.rs:265,:269; SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import IO, Optional
+
+
+class Tracer:
+    def __init__(self, sink: Optional[IO[str]] = None):
+        self.sink = sink
+        self._lock = threading.Lock()
+
+    @property
+    def enabled(self) -> bool:
+        return self.sink is not None
+
+    def event(self, ev: str, **fields) -> None:
+        if self.sink is None:
+            return
+        rec = {"ts": round(time.monotonic(), 6), "ev": ev}
+        rec.update(fields)
+        line = json.dumps(rec, separators=(",", ":")) + "\n"
+        with self._lock:
+            self.sink.write(line)
+            self.sink.flush()
+
+
+_tracer = Tracer()
+
+
+def get_tracer() -> Tracer:
+    return _tracer
+
+
+def set_trace_file(path: Optional[str]) -> Tracer:
+    """Route global tracing to a JSONL file (None disables)."""
+    global _tracer
+    _tracer = Tracer(open(path, "a") if path else None)
+    return _tracer
