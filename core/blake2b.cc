@@ -83,7 +83,7 @@ void blake2b(uint8_t* out, size_t outlen, const uint8_t* in, size_t inlen) {
     inlen -= 128;
   }
   std::memset(block, 0, sizeof(block));
-  std::memcpy(block, in, inlen);
+  if (inlen) std::memcpy(block, in, inlen);  // in may be null for empty input
   t += inlen;
   compress(h, block, t, true);
 

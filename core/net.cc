@@ -259,23 +259,46 @@ void ReplicaServer::flush(Conn& c) {
   }
 }
 
-void ReplicaServer::set_trace_file(const std::string& path) {
+bool ReplicaServer::set_trace_file(const std::string& path) {
+  if (trace_fp_) std::fclose(trace_fp_);
   trace_fp_ = std::fopen(path.c_str(), "a");
+  if (!trace_fp_) {
+    std::fprintf(stderr, "replica %lld: cannot open trace file %s\n",
+                 (long long)id_, path.c_str());
+    return false;
+  }
+  return true;
 }
 
-void ReplicaServer::trace(const char* ev, int64_t size, int64_t rejected,
-                          double secs) {
+namespace {
+double trace_now() {
+  return std::chrono::duration<double>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+}  // namespace
+
+// Event schemas match the Python tracer's (pbft_tpu/net/server.py) so a
+// mixed-runtime cluster's traces merge without per-runtime special cases.
+void ReplicaServer::trace_batch(int64_t size, int64_t rejected, double secs) {
   if (!trace_fp_) return;
-  auto now = std::chrono::duration<double>(
-                 std::chrono::steady_clock::now().time_since_epoch())
-                 .count();
   std::fprintf(trace_fp_,
-               "{\"ts\":%.6f,\"ev\":\"%s\",\"replica\":%lld,\"size\":%lld,"
-               "\"rejected\":%lld,\"secs\":%.6f,\"view\":%lld,"
+               "{\"ts\":%.6f,\"ev\":\"verify_batch\",\"replica\":%lld,"
+               "\"size\":%lld,\"rejected\":%lld,\"secs\":%.6f,\"view\":%lld,"
                "\"executed\":%lld}\n",
-               now, ev, (long long)id_, (long long)size, (long long)rejected,
-               secs, (long long)replica_->view(),
+               trace_now(), (long long)id_, (long long)size,
+               (long long)rejected, secs, (long long)replica_->view(),
                (long long)replica_->executed_upto());
+  std::fflush(trace_fp_);
+}
+
+void ReplicaServer::trace_view_change(int backoff) {
+  if (!trace_fp_) return;
+  std::fprintf(trace_fp_,
+               "{\"ts\":%.6f,\"ev\":\"view_change_start\",\"replica\":%lld,"
+               "\"pending_view\":%lld,\"backoff\":%d}\n",
+               trace_now(), (long long)id_, (long long)(replica_->view() + 1),
+               backoff);
   std::fflush(trace_fp_);
 }
 
@@ -288,9 +311,10 @@ void ReplicaServer::run_verify_batch() {
   if (trace_fp_) {
     int64_t rejected = 0;
     for (uint8_t v : verdicts) rejected += v ? 0 : 1;
-    trace("verify_batch", (int64_t)items.size(), rejected,
-          std::chrono::duration<double>(std::chrono::steady_clock::now() - t0)
-              .count());
+    trace_batch(
+        (int64_t)items.size(), rejected,
+        std::chrono::duration<double>(std::chrono::steady_clock::now() - t0)
+            .count());
   }
   emit(replica_->deliver_verdicts(verdicts));
 }
@@ -355,7 +379,7 @@ void ReplicaServer::check_progress_timer() {
     // No progress within the timeout: suspect the primary. Exponential
     // backoff keeps cascading view changes from thrashing (§4.5.2).
     timer_backoff_ = std::min(timer_backoff_ * 2, 64);
-    trace("view_change_start", 0, 0, 0.0);
+    trace_view_change(timer_backoff_);
     emit(replica_->start_view_change());
   }
   timer_armed_ = false;  // rearmed on the next tick while work pends

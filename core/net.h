@@ -17,6 +17,7 @@
 
 #include <chrono>
 #include <cstdint>
+#include <cstdio>
 #include <map>
 #include <memory>
 #include <string>
@@ -72,7 +73,9 @@ class ReplicaServer {
 
   // Structured JSONL tracing (batch boundaries + view changes only; the
   // reference logged inside the poll hot loop, SURVEY.md §5 — we don't).
-  void set_trace_file(const std::string& path);
+  // Returns false (with a stderr warning) if the file cannot be opened;
+  // closes any previously set sink.
+  bool set_trace_file(const std::string& path);
 
  private:
   void accept_ready();
@@ -92,7 +95,8 @@ class ReplicaServer {
   int64_t id_;
   std::unique_ptr<Verifier> verifier_;
   std::unique_ptr<Replica> replica_;
-  void trace(const char* ev, int64_t size, int64_t rejected, double secs);
+  void trace_batch(int64_t size, int64_t rejected, double secs);
+  void trace_view_change(int backoff);
 
   FILE* trace_fp_ = nullptr;
   std::string discovery_target_;

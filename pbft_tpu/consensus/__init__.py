@@ -12,8 +12,11 @@ from .messages import (
     Prepare,
     Commit,
     Checkpoint,
+    ViewChange,
+    NewView,
     from_wire,
     to_wire,
 )
 from .config import ClusterConfig, ReplicaIdentity
 from .replica import Replica
+from .simulation import Cluster

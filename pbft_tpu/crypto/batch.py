@@ -65,11 +65,23 @@ def pad_batch(items, size: int):
     return pubs, msgs, sigs, n
 
 
+# Padded sizes are drawn from a short ladder so the whole system compiles
+# at most len(_PAD_LADDER) kernel shapes (recompiles are minutes on CPU).
+_PAD_LADDER = (16, 64, 256, 1024, 4096)
+
+
+def pad_size(n: int) -> int:
+    for size in _PAD_LADDER:
+        if n <= size:
+            return size
+    return ((n + _PAD_LADDER[-1] - 1) // _PAD_LADDER[-1]) * _PAD_LADDER[-1]
+
+
 def verify_many(items, pad_to: int | None = None) -> list[bool]:
     """Convenience host API: list of (pub, msg, sig) byte triples -> bools."""
     if not items:
         return []
-    size = pad_to or max(1, 1 << (len(items) - 1).bit_length())
+    size = pad_to or pad_size(len(items))
     pubs, msgs, sigs, n = pad_batch(items, size)
     out = np.asarray(verify_batch(pubs, msgs, sigs))
     return [bool(v) for v in out[:n]]

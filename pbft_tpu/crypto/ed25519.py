@@ -160,12 +160,24 @@ def shamir_ladder(s_bits, h_bits, a_neg):
     a1 = a_neg
     a2 = point_double(a1)
     a3 = point_add(a2, a1)
+    # entries[s + 4h] = [s]B + [h](-A); selected per step by a binary mux
+    # tree on the scalar bits (15 selects/coordinate) — gathers compile
+    # catastrophically slowly on XLA:CPU and no faster on TPU, while
+    # selects fuse into cheap vector ops everywhere.
     entries = list(row0)
     for aj in (a1, a2, a3):
         entries.extend(point_add(p, aj) for p in row0)
-    table = tuple(
-        jnp.stack([e[c] for e in entries], axis=-2) for c in range(4)
-    )
+
+    def mux(bits, items):
+        """items: 2^len(bits) points; bits LSB-first select one."""
+        cur = items
+        for b in bits:
+            cond = (b == 1)[..., None]
+            cur = [
+                tuple(jnp.where(cond, hi[c], lo[c]) for c in range(4))
+                for lo, hi in zip(cur[0::2], cur[1::2])
+            ]
+        return cur[0]
 
     def body(k, acc):
         step = 127 - k
@@ -173,13 +185,7 @@ def shamir_ladder(s_bits, h_bits, a_neg):
         s1 = lax.dynamic_index_in_dim(s_bits, 2 * step + 1, axis=-1, keepdims=False)
         h0 = lax.dynamic_index_in_dim(h_bits, 2 * step, axis=-1, keepdims=False)
         h1 = lax.dynamic_index_in_dim(h_bits, 2 * step + 1, axis=-1, keepdims=False)
-        idx = (s0 + 2 * s1 + 4 * (h0 + 2 * h1)).astype(jnp.int64)
-        sel = tuple(
-            jnp.take_along_axis(
-                table[c], idx[..., None, None], axis=-2
-            ).squeeze(-2)
-            for c in range(4)
-        )
+        sel = mux([s0, s1, h0, h1], entries)
         acc = point_double(point_double(acc))
         return point_add(acc, sel)
 

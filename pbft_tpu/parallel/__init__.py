@@ -16,6 +16,12 @@ from .verifier import (
     quorum_certify,
     round_step,
 )
+from .multihost import (
+    global_mesh,
+    host_shard_to_global,
+    initialize_distributed,
+    partition_items,
+)
 
 __all__ = [
     "QuorumResult",
@@ -23,4 +29,8 @@ __all__ = [
     "sharded_verify",
     "quorum_certify",
     "round_step",
+    "global_mesh",
+    "host_shard_to_global",
+    "initialize_distributed",
+    "partition_items",
 ]

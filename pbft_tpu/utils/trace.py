@@ -42,7 +42,11 @@ def get_tracer() -> Tracer:
 
 
 def set_trace_file(path: Optional[str]) -> Tracer:
-    """Route global tracing to a JSONL file (None disables)."""
+    """Route global tracing to a JSONL file (None disables); closes any
+    previously set sink. Raises OSError if the file cannot be opened."""
     global _tracer
+    old_sink = _tracer.sink
     _tracer = Tracer(open(path, "a") if path else None)
+    if old_sink is not None:
+        old_sink.close()
     return _tracer

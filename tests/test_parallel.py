@@ -87,6 +87,29 @@ def test_round_step_runs_and_is_deterministic():
     assert not np.array_equal(np.asarray(s1), np.zeros(8, np.int32))
 
 
+def test_multihost_helpers_single_process():
+    """The multi-host helpers degrade to single-process correctly (the
+    same code path a one-host deployment runs)."""
+    from pbft_tpu.parallel import (
+        global_mesh,
+        host_shard_to_global,
+        initialize_distributed,
+        partition_items,
+    )
+
+    initialize_distributed()  # no-op single process
+    mesh = global_mesh()
+    assert mesh.devices.size == 8
+    local = np.arange(16 * 32, dtype=np.uint8).reshape(16, 32)
+    arr = host_shard_to_global(mesh, local)
+    assert arr.shape == (16, 32)
+    assert np.array_equal(np.asarray(arr), local)
+    items = list(range(10))
+    assert partition_items(items, process_id=0, num=2) == [0, 2, 4, 6, 8]
+    assert partition_items(items, process_id=1, num=2) == [1, 3, 5, 7, 9]
+    assert partition_items(items) == items  # single process keeps all
+
+
 def test_sharded_matches_unsharded():
     from pbft_tpu.crypto.batch import verify_batch
 
