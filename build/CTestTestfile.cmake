@@ -1,0 +1,8 @@
+# CMake generated Testfile for 
+# Source directory: /root/repo/core
+# Build directory: /root/repo/build
+# 
+# This file includes the relevant testing commands required for 
+# testing this directory and lists subdirectories to be tested as well.
+add_test(core_test "/root/repo/build/core_test")
+set_tests_properties(core_test PROPERTIES  _BACKTRACE_TRIPLES "/root/repo/core/CMakeLists.txt;39;add_test;/root/repo/core/CMakeLists.txt;0;")

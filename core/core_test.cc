@@ -197,6 +197,69 @@ void test_view_change_native() {
   CHECK(c.replies.size() >= 3);
 }
 
+// Sign a message exactly like Replica::sign (signable over the sig-less
+// canonical form), from a raw seed — lets tests forge *correctly signed*
+// Byzantine evidence.
+template <typename M>
+M test_sign(M msg, const std::vector<uint8_t>& seed) {
+  uint8_t digest[32], sig[64];
+  pbft::message_signable(pbft::Message(msg), digest);
+  pbft::ed25519_sign(sig, seed.data(), digest, 32);
+  msg.sig = pbft::to_hex(sig, 64);
+  return msg;
+}
+
+void test_stable_digest_majority_native() {
+  // Mirrors tests/test_view_change.py::
+  // test_stable_digest_ignores_byzantine_first_checkpoint for the C++
+  // runtime: a view-change checkpoint proof listing a correctly-signed
+  // bogus-digest entry *first* must not decide the adopted state digest —
+  // the 2f+1 majority does. Also pins seq_counter's low-mark floor: the
+  // first post-view-change request gets seq min_s + 1.
+  std::vector<std::vector<uint8_t>> seeds;
+  auto cfg = test_config(&seeds);
+  MiniCluster c(cfg, seeds);
+  std::string good(64, 'a');
+  std::string evil(64, 'c');
+  pbft::JsonArray proof;
+  for (int i = 0; i < 4; ++i) {
+    pbft::Checkpoint cp;
+    cp.seq = 10;
+    cp.digest = (i == 0) ? evil : good;
+    cp.replica = i;
+    proof.push_back(test_sign(cp, seeds[i]).to_json());
+  }
+  for (int i = 1; i < 4; ++i) {
+    pbft::ViewChange vc;
+    vc.new_view = 1;
+    vc.last_stable_seq = 10;
+    vc.checkpoint_proof = proof;
+    vc.replica = i;
+    c.route(1, pbft::Message(test_sign(vc, seeds[i])));
+    c.route(2, pbft::Message(test_sign(vc, seeds[i])));
+    c.route(3, pbft::Message(test_sign(vc, seeds[i])));
+  }
+  c.inboxes[0].clear();
+  c.run();
+  c.inboxes[0].clear();
+  for (int i = 1; i < 4; ++i) {
+    CHECK(c.replicas[i].view() == 1);
+    CHECK(!c.replicas[i].in_view_change());
+    CHECK(c.replicas[i].low_mark() == 10);
+    CHECK(c.replicas[i].executed_upto() == 10);
+    CHECK(c.replicas[i].state_digest_hex() == good);
+  }
+  // New primary 1 assigns seq 11 (= max(low_mark, min_s) + 1), not 1.
+  pbft::ClientRequest req;
+  req.operation = "post-vc";
+  req.timestamp = 5;
+  req.client = "127.0.0.1:9999";
+  auto acts = c.replicas[1].on_client_request(req);
+  CHECK(acts.broadcasts.size() == 1);
+  auto* pp = std::get_if<pbft::PrePrepare>(&acts.broadcasts[0].msg);
+  CHECK(pp && pp->seq == 11);
+}
+
 }  // namespace
 
 int main() {
@@ -206,6 +269,7 @@ int main() {
   test_canonical_json();
   test_four_replica_commit();
   test_view_change_native();
+  test_stable_digest_majority_native();
   if (g_failures) {
     std::fprintf(stderr, "%d failure(s)\n", g_failures);
     return 1;

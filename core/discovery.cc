@@ -7,6 +7,7 @@
 #include <unistd.h>
 
 #include <cstdio>
+#include <chrono>
 #include <cstring>
 
 #include "json.h"
@@ -14,8 +15,9 @@
 namespace pbft {
 
 Discovery::Discovery(const std::string& target, int64_t replica_id,
-                     int tcp_port)
-    : id_(replica_id), tcp_port_(tcp_port) {
+                     int tcp_port, int64_t cluster_n, int expiry_ms)
+    : id_(replica_id), tcp_port_(tcp_port), cluster_n_(cluster_n),
+      expiry_ms_(expiry_ms) {
   auto colon = target.rfind(':');
   if (colon == std::string::npos) {
     group_ = target;
@@ -85,13 +87,16 @@ void Discovery::announce() {
 
 void Discovery::poll(std::map<int64_t, std::string>* peer_addrs) {
   if (recv_fd_ < 0) return;
+  int64_t now_ms = std::chrono::duration_cast<std::chrono::milliseconds>(
+                       std::chrono::steady_clock::now().time_since_epoch())
+                       .count();
   char buf[512];
   sockaddr_in src{};
   socklen_t slen = sizeof(src);
   for (;;) {
     ssize_t r = recvfrom(recv_fd_, buf, sizeof(buf) - 1, 0, (sockaddr*)&src,
                          &slen);
-    if (r <= 0) return;
+    if (r <= 0) break;
     buf[r] = 0;
     auto j = Json::parse(std::string(buf, (size_t)r));
     if (!j) continue;
@@ -100,10 +105,27 @@ void Discovery::poll(std::map<int64_t, std::string>* peer_addrs) {
     if (!idj || !portj) continue;
     int64_t rid = idj->as_int();
     if (rid == id_) continue;
+    // Membership bound: the channel is unauthenticated; ids outside the
+    // configured cluster must not grow the map.
+    if (rid < 0 || (cluster_n_ > 0 && rid >= cluster_n_)) continue;
     char host[INET_ADDRSTRLEN];
     if (!inet_ntop(AF_INET, &src.sin_addr, host, sizeof(host))) continue;
     (*peer_addrs)[rid] =
         std::string(host) + ":" + std::to_string((int)portj->as_int());
+    last_seen_ms_[rid] = now_ms;
+  }
+  // Expire peers whose beacons stopped (moved ports / died): remove the
+  // stale address so reconnects wait for a fresh beacon instead of dialing
+  // the old endpoint forever.
+  if (expiry_ms_ > 0) {
+    for (auto it = last_seen_ms_.begin(); it != last_seen_ms_.end();) {
+      if (now_ms - it->second > expiry_ms_) {
+        peer_addrs->erase(it->first);
+        it = last_seen_ms_.erase(it);
+      } else {
+        ++it;
+      }
+    }
   }
 }
 

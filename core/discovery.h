@@ -17,14 +17,20 @@ namespace pbft {
 
 class Discovery {
  public:
-  // target: "group:port", e.g. "239.255.77.77:17700".
-  Discovery(const std::string& target, int64_t replica_id, int tcp_port);
+  // target: "group:port", e.g. "239.255.77.77:17700". cluster_n bounds the
+  // accepted beacon ids to [0, cluster_n) — the multicast channel is
+  // unauthenticated, so ids outside the configured cluster are dropped
+  // instead of growing the peer map without limit. expiry_ms ages out peers
+  // whose beacons stop (the reference's mDNS-expiry TODO,
+  // reference src/network_behaviour_composer.rs:34-40).
+  Discovery(const std::string& target, int64_t replica_id, int tcp_port,
+            int64_t cluster_n = 0, int expiry_ms = 10000);
   ~Discovery();
 
   bool start();  // join the group on loopback + bind; false on error
   // Send one beacon (call ~1/s).
   void announce();
-  // Drain received beacons into id -> "host:port".
+  // Drain received beacons into id -> "host:port"; expire silent peers.
   void poll(std::map<int64_t, std::string>* peer_addrs);
 
  private:
@@ -32,8 +38,11 @@ class Discovery {
   int port_ = 0;
   int64_t id_;
   int tcp_port_;
+  int64_t cluster_n_;
+  int expiry_ms_;
   int recv_fd_ = -1;
   int send_fd_ = -1;
+  std::map<int64_t, int64_t> last_seen_ms_;  // id -> steady-clock millis
 };
 
 }  // namespace pbft

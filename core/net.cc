@@ -87,7 +87,8 @@ bool ReplicaServer::start() {
   set_nonblocking(listen_fd_);
   if (!discovery_target_.empty()) {
     discovery_ =
-        std::make_unique<Discovery>(discovery_target_, id_, listen_port_);
+        std::make_unique<Discovery>(discovery_target_, id_, listen_port_,
+                                    cfg_.n());
     if (!discovery_->start()) {
       std::fprintf(stderr, "replica %lld: discovery on %s failed\n",
                    (long long)id_, discovery_target_.c_str());

@@ -486,11 +486,37 @@ JsonArray Replica::prepared_proofs() const {
   return out;
 }
 
+namespace {
+// THE quorum rule for stable-checkpoint evidence: the digest backed by
+// >= quorum *distinct replicas* in a checkpoint proof, or nullptr. Used by
+// both validate_view_change (to accept a proof) and stable_digest_for (to
+// pick the digest adopted during the watermark jump) — a proof may also
+// carry correctly-signed checkpoints with a minority (Byzantine) digest, so
+// neither entry order nor repeated entries from one replica may influence
+// the choice.
+const std::string* majority_digest(const JsonArray& proof, int64_t quorum) {
+  std::set<int64_t> seen;
+  std::map<std::string, int64_t> by_digest;
+  for (const Json& d : proof) {
+    const Json* rid = d.find("replica");
+    const Json* dig = d.find("digest");
+    if (!rid || !dig || !dig->is_string()) continue;
+    if (!seen.insert(rid->as_int()).second) continue;
+    by_digest[dig->as_string()] += 1;
+  }
+  for (const Json& d : proof) {
+    const Json* dig = d.find("digest");
+    if (dig && dig->is_string() && by_digest[dig->as_string()] >= quorum)
+      return &dig->as_string();
+  }
+  return nullptr;
+}
+}  // namespace
+
 bool Replica::validate_view_change(const ViewChange& vc) const {
   // C: 2f+1 checkpoint messages proving last_stable_seq.
   if (vc.last_stable_seq > 0) {
     std::set<int64_t> seen;
-    std::map<std::string, int64_t> by_digest;
     for (const Json& d : vc.checkpoint_proof) {
       auto m = message_from_json(d);
       if (!m) return false;
@@ -499,11 +525,9 @@ bool Replica::validate_view_change(const ViewChange& vc) const {
       if (seen.count(cp->replica)) return false;
       if (!verify_inline(cp->replica, *m, cp->sig)) return false;
       seen.insert(cp->replica);
-      by_digest[cp->digest] += 1;
     }
-    int64_t most = 0;
-    for (const auto& [d, c] : by_digest) most = std::max(most, c);
-    if (most < 2 * config_.f() + 1) return false;
+    if (!majority_digest(vc.checkpoint_proof, 2 * config_.f() + 1))
+      return false;
   }
   // P: each prepared certificate internally consistent + signed.
   for (const Json& proof : vc.prepared_proofs) {
@@ -609,12 +633,11 @@ std::pair<int64_t, std::vector<Replica::OEntry>> Replica::compute_o(
 
 namespace {
 const std::string* stable_digest_for(const std::vector<ViewChange>& vcs,
-                                     int64_t min_s) {
+                                     int64_t min_s, int64_t f) {
   for (const auto& vc : vcs) {
-    if (vc.last_stable_seq == min_s && !vc.checkpoint_proof.empty()) {
-      const Json* d = vc.checkpoint_proof.front().find("digest");
-      if (d && d->is_string()) return &d->as_string();
-    }
+    if (vc.last_stable_seq != min_s || vc.checkpoint_proof.empty()) continue;
+    const std::string* dig = majority_digest(vc.checkpoint_proof, 2 * f + 1);
+    if (dig) return dig;
   }
   return nullptr;
 }
@@ -652,7 +675,7 @@ Actions Replica::maybe_new_view(int64_t v) {
   new_view_sent_.insert(v);
   Actions out;
   out.broadcasts.push_back({Message(nv)});
-  out.merge(enter_new_view(v, min_s, stable_digest_for(vcs, min_s), pps));
+  out.merge(enter_new_view(v, min_s, stable_digest_for(vcs, min_s, config_.f()), pps));
   return out;
 }
 
@@ -691,7 +714,7 @@ Actions Replica::on_new_view(const NewView& nv) {
     if (!verify_inline(pp->replica, *m, pp->sig)) return {};
     pps.push_back(*pp);
   }
-  return enter_new_view(nv.new_view, min_s, stable_digest_for(vcs, min_s),
+  return enter_new_view(nv.new_view, min_s, stable_digest_for(vcs, min_s, config_.f()),
                         pps);
 }
 
@@ -710,8 +733,26 @@ Actions Replica::enter_new_view(int64_t v, int64_t min_s,
     advance_watermark(min_s, *stable_digest);
   }
   // The new primary continues the sequence after the re-issued slots.
-  seq_counter_ = min_s;
+  // low_mark is included: when this replica's stable checkpoint is ahead of
+  // min_s, seqs <= low_mark are executed everywhere and would never reply.
+  seq_counter_ = std::max(min_s, low_mark_);
   for (const auto& pp : pps) seq_counter_ = std::max(seq_counter_, pp.seq);
+  // Prune normal-case log entries from abandoned views above min_s that the
+  // quorum did not re-issue: they can never prepare in view v, and keeping
+  // them makes has_unexecuted() fire the request timer forever.
+  std::set<int64_t> reissued;
+  for (const auto& pp : pps) reissued.insert(pp.seq);
+  auto prune_old_views = [&](auto& log) {
+    for (auto it = log.begin(); it != log.end();) {
+      if (it->first.first < v && !reissued.count(it->first.second))
+        it = log.erase(it);
+      else
+        ++it;
+    }
+  };
+  prune_old_views(pre_prepares_);
+  prune_old_views(prepares_);
+  prune_old_views(commits_);
   Actions out;
   for (const auto& pp : pps) out.merge(on_pre_prepare(pp));
   return out;

@@ -73,6 +73,7 @@ class Replica {
   int64_t high_mark() const { return low_mark_ + config_.watermark_window; }
   int64_t executed_upto() const { return executed_upto_; }
   int64_t low_mark() const { return low_mark_; }
+  std::string state_digest_hex() const { return to_hex(state_digest_, 32); }
 
   // Client request path (unauthenticated, like the reference's client
   // contract); backups forward to the primary.

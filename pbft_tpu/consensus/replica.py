@@ -507,7 +507,6 @@ class Replica:
         # C: 2f+1 checkpoint messages proving last_stable_seq.
         if vc.last_stable_seq > 0:
             seen: Set[int] = set()
-            by_digest: Dict[str, int] = {}
             for d in vc.checkpoint_proof:
                 try:
                     cp = Message.from_dict(dict(d))
@@ -520,8 +519,7 @@ class Replica:
                 if not self._verify_inline(cp.replica, cp.signable(), cp.sig):
                     return False
                 seen.add(cp.replica)
-                by_digest[cp.digest] = by_digest.get(cp.digest, 0) + 1
-            if not by_digest or max(by_digest.values()) < 2 * self.config.f + 1:
+            if self._majority_digest(vc.checkpoint_proof) is None:
                 return False
         # P: each prepared certificate is internally consistent + signed.
         for proof in vc.prepared_proofs:
@@ -602,10 +600,34 @@ class Replica:
                 entries.append((n, null_request().digest(), None))
         return min_s, entries
 
+    def _majority_digest(self, proof) -> Optional[str]:
+        """The digest backed by >= 2f+1 *distinct replicas* in a checkpoint
+        proof, or None. This is THE quorum rule for stable-checkpoint
+        evidence: _validate_view_change uses it to accept a proof and
+        _stable_digest_for to pick the digest adopted during the watermark
+        jump — a proof may also carry correctly-signed checkpoints with a
+        minority (Byzantine) digest, so neither entry order nor repeated
+        entries from one replica may influence the choice."""
+        seen: Set[int] = set()
+        by_digest: Dict[str, int] = {}
+        for d in proof:
+            d = dict(d)
+            rid, dig = d.get("replica"), d.get("digest")
+            if rid in seen or not isinstance(dig, str):
+                continue
+            seen.add(rid)
+            by_digest[dig] = by_digest.get(dig, 0) + 1
+        for dig, count in by_digest.items():
+            if count >= 2 * self.config.f + 1:
+                return dig
+        return None
+
     def _stable_digest_for(self, vcs: List[ViewChange], min_s: int) -> Optional[str]:
         for vc in vcs:
             if vc.last_stable_seq == min_s and vc.checkpoint_proof:
-                return dict(vc.checkpoint_proof[0])["digest"]
+                dig = self._majority_digest(vc.checkpoint_proof)
+                if dig is not None:
+                    return dig
         return None
 
     def _maybe_new_view(self, v: int) -> List[Action]:
@@ -709,7 +731,20 @@ class Replica:
             self._advance_watermark(min_s, stable_digest)
         # The new primary continues the sequence after the re-issued slots;
         # harmless for backups (their seq_counter is unused until they lead).
-        self.seq_counter = max(min_s, max((pp.seq for pp in pps), default=min_s))
+        # low_mark is included: when this replica's stable checkpoint is
+        # ahead of min_s (its view-change wasn't among the 2f+1 lowest ids),
+        # seqs <= low_mark are already executed everywhere and would never
+        # reply if re-assigned.
+        self.seq_counter = max(
+            self.low_mark, min_s, max((pp.seq for pp in pps), default=min_s)
+        )
+        # Prune normal-case log entries from abandoned views above min_s that
+        # the quorum did not re-issue: they can never prepare in view v, and
+        # keeping them makes has_unexecuted() fire the request timer forever.
+        reissued = {pp.seq for pp in pps}
+        for log in (self.pre_prepares, self.prepares, self.commits):
+            for key in [k for k in log if k[0] < v and k[1] not in reissued]:
+                del log[key]
         out: List[Action] = []
         for pp in pps:
             out.extend(self._on_pre_prepare(pp))

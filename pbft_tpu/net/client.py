@@ -127,11 +127,23 @@ class PbftClient:
         deadline = time.monotonic() + timeout
         with self._new_reply:
             while True:
-                by_result: Dict[Tuple[str, int], int] = {}
+                # One vote per replica id (PBFT §4.1: f+1 replies from
+                # *different* replicas) — retransmitted/duplicated replies
+                # from a single replica must not satisfy the quorum.
+                votes: Dict[int, Tuple[str, int]] = {}
                 for r in self.replies:
+                    rid = r.get("replica")
+                    # Membership bound: the reply channel is unauthenticated,
+                    # so ids outside the configured cluster must not mint
+                    # extra votes (full §4.1 needs reply signatures; the
+                    # bound at least caps a forger to its own one vote).
+                    if not isinstance(rid, int) or not 0 <= rid < self.config.n:
+                        continue
                     if r.get("timestamp") == timestamp:
-                        key = (r.get("result"), r.get("view"))
-                        by_result[key] = by_result.get(key, 0) + 1
+                        votes[rid] = (r.get("result"), r.get("view"))
+                by_result: Dict[Tuple[str, int], int] = {}
+                for key in votes.values():
+                    by_result[key] = by_result.get(key, 0) + 1
                 for (result, _view), count in by_result.items():
                     if count >= f + 1:
                         return result

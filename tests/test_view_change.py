@@ -170,3 +170,64 @@ def test_view_change_message_roundtrip():
     back = from_wire(frame[4:])
     assert back == bcast.msg
     assert back.signable() == bcast.msg.signable()
+
+
+def test_stable_digest_ignores_byzantine_first_checkpoint():
+    """A view-change proof may carry extra correctly-signed checkpoints with
+    a bogus digest; the adopted stable digest must be the one with a 2f+1
+    majority, not whichever entry the (possibly Byzantine) sender listed
+    first (PBFT §4.4 / §5.3 — digest adoption during the watermark jump)."""
+    from pbft_tpu.consensus.messages import Checkpoint
+
+    config, seeds = make_local_cluster(4)
+    replicas = [Replica(config, i, seeds[i]) for i in range(4)]
+    good = "ab" * 32
+    evil = "cd" * 32
+    # Replicas 1..3 certify `good` at seq 10; Byzantine replica 0 signs
+    # `evil` for the same seq. All four signatures are genuine.
+    proof = [
+        replicas[0]._sign(Checkpoint(seq=10, digest=evil, replica=0)).to_dict()
+    ] + [
+        replicas[i]._sign(Checkpoint(seq=10, digest=good, replica=i)).to_dict()
+        for i in (1, 2, 3)
+    ]
+    vc = replicas[1]._sign(
+        ViewChange(
+            new_view=1,
+            last_stable_seq=10,
+            checkpoint_proof=tuple(proof),
+            prepared_proofs=(),
+            replica=1,
+        )
+    )
+    # The proof as a whole is valid (a 2f+1 majority on `good` exists)...
+    assert replicas[2]._validate_view_change(vc)
+    # ...but the stable digest must be the majority one, not proof[0]'s.
+    assert replicas[2]._stable_digest_for([vc], 10) == good
+
+
+def test_client_reply_quorum_one_vote_per_replica():
+    """f+1 reply quorum must count distinct replicas: duplicate replies from
+    one replica (retransmissions on the unauthenticated reply channel) do
+    not satisfy it (PBFT §4.1)."""
+    import pytest
+
+    from pbft_tpu.net.client import PbftClient
+
+    config, _seeds = make_local_cluster(4)
+    client = PbftClient.__new__(PbftClient)
+    client.config = config
+    import threading
+
+    client._new_reply = threading.Condition()
+    # Three copies of replica 2's reply: one vote, no quorum.
+    client.replies = [
+        {"timestamp": 7, "result": "awesome!", "view": 0, "replica": 2}
+    ] * 3
+    with pytest.raises(TimeoutError):
+        client.wait_result(7, timeout=0.2)
+    # A second distinct replica completes the f+1 = 2 quorum.
+    client.replies.append(
+        {"timestamp": 7, "result": "awesome!", "view": 0, "replica": 3}
+    )
+    assert client.wait_result(7, timeout=0.2) == "awesome!"
