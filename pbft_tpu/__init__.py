@@ -19,12 +19,9 @@ re-designed TPU-first:
 - ``pbft_tpu.net``       — client gateway contract (JSON request in, dial-back
   reply out; reference src/client_handler.rs) and the cluster launcher.
 
-JAX x64 is required for the uint64/int64 limb arithmetic used by the crypto
-kernels; importing this package enables it (before any jax usage).
+All crypto kernels use native 32-bit arithmetic (int32 8-bit limbs, uint32
+SHA-512 word halves) — the TPU vector unit's native width — so this package
+neither needs nor touches jax x64 mode.
 """
-
-import jax
-
-jax.config.update("jax_enable_x64", True)
 
 __version__ = "0.1.0"

@@ -2,7 +2,7 @@
 
 - ``ref``     — pure-Python (big-int) Ed25519: the correctness oracle, key
   generation, and the signer used by clients/replicas on the host side.
-- ``sha512``  — JAX SHA-512 (uint64), fixed-shape, vmappable.
+- ``sha512``  — JAX SHA-512 (uint32 pairs), fixed-shape, vmappable.
 - ``field``   — JAX GF(2^255-19) and mod-L limb arithmetic.
 - ``ed25519`` — JAX Ed25519 verification (decompress, Shamir double-scalar
   ladder, compress) built on ``field`` + ``sha512``.

@@ -18,8 +18,9 @@ Scalar pipeline per item (pub 32B, msg 32B digest, sig 64B = R||S):
 Cofactorless equation, strict S < L: bit-for-bit the same accept set as the
 pure-Python oracle pbft_tpu.crypto.ref (RFC 8032).
 
-Points are tuples (X, Y, Z, T) of (..., 16)-limb field elements with
-T = XY/Z. All control flow is static; everything vmaps/jits.
+Points are tuples (X, Y, Z, T) of (..., 32)-limb int32 field elements with
+T = XY/Z (radix 2^8 — native width for the TPU's 32-bit vector unit; see
+field.py). All control flow is static; everything vmaps/jits.
 """
 
 from __future__ import annotations
@@ -43,16 +44,33 @@ _BT = F.limbs_const(ref.BASE[0] * ref.BASE[1] % F.P)
 _ONE = F.limbs_const(1)
 _ZERO = F.limbs_const(0)
 
+# [0]B, [1]B, [2]B, [3]B in extended coords (X, Y, Z=1, T=XY) — the static
+# row of the Shamir table, precomputed from the oracle so the ladder never
+# spends traced point ops on base multiples.
+_B2 = ref.point_add(ref.BASE, ref.BASE)
+_B3 = ref.point_add(_B2, ref.BASE)
+_ROW0 = tuple(
+    np.stack([F.limbs_const(v) for v in coords])
+    for coords in zip(
+        *(
+            (0, 1, 1, 0),  # identity
+            (ref.BASE[0], ref.BASE[1], 1, ref.BASE[0] * ref.BASE[1] % F.P),
+            (_B2[0], _B2[1], 1, _B2[0] * _B2[1] % F.P),
+            (_B3[0], _B3[1], 1, _B3[0] * _B3[1] % F.P),
+        )
+    )
+)  # 4 arrays of shape (4, 32): X-row, Y-row, Z-row, T-row
+
 
 def identity(shape=()):
-    z = jnp.broadcast_to(jnp.asarray(_ZERO), shape + (16,))
-    o = jnp.broadcast_to(jnp.asarray(_ONE), shape + (16,))
+    z = jnp.broadcast_to(jnp.asarray(_ZERO), shape + (F.NLIMBS,))
+    o = jnp.broadcast_to(jnp.asarray(_ONE), shape + (F.NLIMBS,))
     return (z, o, o, z)
 
 
 def base_point(shape=()):
     return tuple(
-        jnp.broadcast_to(jnp.asarray(c), shape + (16,))
+        jnp.broadcast_to(jnp.asarray(c), shape + (F.NLIMBS,))
         for c in (_BX, _BY, _ONE, _BT)
     )
 
@@ -108,13 +126,13 @@ def sqrt_ratio(u, v):
 def decompress(ybytes):
     """(…,32) uint8 -> (ok, point). RFC 8032 §5.1.3 decoding."""
     ybytes = jnp.asarray(ybytes, jnp.uint8)
-    sign = (ybytes[..., 31] >> 7).astype(jnp.int64)
+    sign = (ybytes[..., 31] >> 7).astype(jnp.int32)
     masked = ybytes.at[..., 31].set(ybytes[..., 31] & 0x7F)
     y = F.bytes_to_limbs(masked)
     # Canonical check: y < p.
     b = jnp.zeros_like(y[..., 0])
     for i in range(F.NLIMBS):
-        b = (y[..., i] - jnp.asarray(F._P_LIMBS)[i] + b) >> 16
+        b = (y[..., i] - jnp.asarray(F._P_LIMBS)[i] + b) >> F.RADIX
     ok_canon = b < 0
     y2 = F.sqr(y)
     u = F.sub(y2, jnp.asarray(_ONE))
@@ -143,41 +161,48 @@ def shamir_ladder(s_bits, h_bits, a_neg):
     """[S]B + [h]*(-A) with a joint 2-bit window: one 16-entry table lookup
     per pair of scalar bits. 128 iterations of (2 doublings + 1 addition)
     instead of 256 x (double + add) — ~40% fewer point operations, and the
-    whole loop is static control flow (fori_loop) with gather-based table
-    selection, exactly what XLA tiles well.
+    whole loop is static control flow (fori_loop) with select-based table
+    lookup, exactly what XLA tiles well.
 
-    s_bits, h_bits: (…,256) int32 LSB-first; a_neg: point with (…,16) coords.
+    s_bits, h_bits: (…,256) int32 LSB-first; a_neg: point with (…,32) coords.
     """
     shape = s_bits.shape[:-1]
-    b1 = base_point(shape)
-    ident = identity(shape)
-    # Table T[i + 4j] = [i]B + [j](-A) for i, j in 0..3. The B-multiples
-    # row is static (broadcast constants); the three -A rows cost 3 + 12
-    # one-time additions — amortized over 128 saved per-bit additions.
-    b2 = point_double(b1)
-    b3 = point_add(b2, b1)
-    row0 = [ident, b1, b2, b3]
+    # Table E[s + 4h] = [s]B + [h](-A) for s, h in 0..3, held as STACKED
+    # arrays (16, …, 32) per coordinate. The B-multiples row is a static
+    # constant (_ROW0); the three -A rows cost one doubling, one addition,
+    # and ONE batched addition traced over a (3, 4) leading axis — the
+    # stacked layout keeps the traced graph a single point_add instead of
+    # twelve, and the mux below is 4 selects per coordinate instead of 15.
+    row0 = tuple(
+        jnp.broadcast_to(
+            jnp.asarray(c).reshape((4,) + (1,) * len(shape) + (F.NLIMBS,)),
+            (4,) + shape + (F.NLIMBS,),
+        )
+        for c in _ROW0
+    )
     a1 = a_neg
     a2 = point_double(a1)
     a3 = point_add(a2, a1)
-    # entries[s + 4h] = [s]B + [h](-A); selected per step by a binary mux
-    # tree on the scalar bits (15 selects/coordinate) — gathers compile
-    # catastrophically slowly on XLA:CPU and no faster on TPU, while
-    # selects fuse into cheap vector ops everywhere.
-    entries = list(row0)
-    for aj in (a1, a2, a3):
-        entries.extend(point_add(p, aj) for p in row0)
+    arows = tuple(
+        jnp.stack([a1[c], a2[c], a3[c]], axis=0)[:, None]
+        for c in range(4)
+    )  # (3, 1, …, 32) per coordinate
+    prods = point_add(tuple(r[None] for r in row0), arows)  # (3, 4, …, 32)
+    entries = tuple(
+        jnp.concatenate([row0[c][None], prods[c]], axis=0).reshape(
+            (16,) + shape + (F.NLIMBS,)
+        )
+        for c in range(4)
+    )  # index = 4h + s
 
-    def mux(bits, items):
-        """items: 2^len(bits) points; bits LSB-first select one."""
-        cur = items
+    def mux(bits, table):
+        """table: coordinate arrays with a leading 2^len(bits) axis;
+        bits LSB-first halve it with one select per level."""
+        cur = table
         for b in bits:
             cond = (b == 1)[..., None]
-            cur = [
-                tuple(jnp.where(cond, hi[c], lo[c]) for c in range(4))
-                for lo, hi in zip(cur[0::2], cur[1::2])
-            ]
-        return cur[0]
+            cur = tuple(jnp.where(cond, c[1::2], c[0::2]) for c in cur)
+        return tuple(c[0] for c in cur)
 
     def body(k, acc):
         step = 127 - k
@@ -189,7 +214,7 @@ def shamir_ladder(s_bits, h_bits, a_neg):
         acc = point_double(point_double(acc))
         return point_add(acc, sel)
 
-    return lax.fori_loop(0, 128, body, ident)
+    return lax.fori_loop(0, 128, body, identity(shape))
 
 
 def verify_kernel(pub, msg, sig):

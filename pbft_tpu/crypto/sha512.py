@@ -1,9 +1,15 @@
-"""SHA-512 in JAX (uint64), fixed-shape and vmappable.
+"""SHA-512 in JAX as uint32 pairs, fixed-shape and vmappable.
 
 Used for the Ed25519 challenge hash h = SHA-512(R || A || M) inside the
 batched TPU verifier. PBFT messages are signed over their 32-byte Blake2b
 digests, so the hash input is always exactly 96 bytes — one SHA-512 block
 after padding — which keeps every shape static for XLA.
+
+Each 64-bit word lives as a (hi, lo) pair of **uint32** lanes: the TPU's
+vector unit is 32-bit, so this runs native-width instead of forcing jax x64
+mode and emulated 64-bit ops. Rotations/shifts split across the pair with
+static shift counts; 64-bit addition propagates one carry computed by an
+unsigned compare.
 
 The round constants and initial state are derived at import time from first
 principles (fractional bits of square/cube roots of the first primes,
@@ -11,8 +17,7 @@ FIPS 180-4 §4.2.3/§5.3.5) rather than transcribed, and the whole module is
 known-answer tested against hashlib.
 
 All functions accept arbitrary leading batch dimensions; the message length
-must be static. uint64 arithmetic relies on jax x64 mode (enabled by
-``pbft_tpu.__init__``).
+must be static.
 """
 
 from __future__ import annotations
@@ -47,61 +52,117 @@ def _iroot(n: int, k: int) -> int:
 
 _PRIMES = _primes(80)
 # H0_i = first 64 fractional bits of sqrt(prime_i); K_t likewise for cbrt.
-_H0 = np.array(
-    [math.isqrt(p << 128) & _MASK64 for p in _PRIMES[:8]], dtype=np.uint64
-)
-_K = np.array([_iroot(p << 192, 3) & _MASK64 for p in _PRIMES], dtype=np.uint64)
+_H0 = [math.isqrt(p << 128) & _MASK64 for p in _PRIMES[:8]]
+_K = [_iroot(p << 192, 3) & _MASK64 for p in _PRIMES]
+_H0_HI = np.array([h >> 32 for h in _H0], dtype=np.uint32)
+_H0_LO = np.array([h & 0xFFFFFFFF for h in _H0], dtype=np.uint32)
+_K_HI = np.array([k >> 32 for k in _K], dtype=np.uint32)
+_K_LO = np.array([k & 0xFFFFFFFF for k in _K], dtype=np.uint32)
+
+
+# A 64-bit lane is the pair (hi, lo); all helpers below take/return pairs.
+
+
+def _add64(a, b):
+    hi, lo = a[0] + b[0], a[1] + b[1]
+    return hi + (lo < a[1]).astype(jnp.uint32), lo
 
 
 def _rotr(x, n: int):
-    n = np.uint64(n)
-    return (x >> n) | (x << np.uint64(64 - int(n)))
+    hi, lo = x
+    if n >= 32:
+        hi, lo = lo, hi
+        n -= 32
+    if n == 0:
+        return hi, lo
+    ns, ms = jnp.uint32(n), jnp.uint32(32 - n)
+    return ((hi >> ns) | (lo << ms), (lo >> ns) | (hi << ms))
+
+
+def _shr(x, n: int):
+    """Logical right shift by n < 32."""
+    hi, lo = x
+    ns, ms = jnp.uint32(n), jnp.uint32(32 - n)
+    return (hi >> ns, (lo >> ns) | (hi << ms))
+
+
+def _xor(*xs):
+    hi = xs[0][0]
+    lo = xs[0][1]
+    for x in xs[1:]:
+        hi = hi ^ x[0]
+        lo = lo ^ x[1]
+    return hi, lo
 
 
 def _big_sigma0(x):
-    return _rotr(x, 28) ^ _rotr(x, 34) ^ _rotr(x, 39)
+    return _xor(_rotr(x, 28), _rotr(x, 34), _rotr(x, 39))
 
 
 def _big_sigma1(x):
-    return _rotr(x, 14) ^ _rotr(x, 18) ^ _rotr(x, 41)
+    return _xor(_rotr(x, 14), _rotr(x, 18), _rotr(x, 41))
 
 
 def _small_sigma0(x):
-    return _rotr(x, 1) ^ _rotr(x, 8) ^ (x >> np.uint64(7))
+    return _xor(_rotr(x, 1), _rotr(x, 8), _shr(x, 7))
 
 
 def _small_sigma1(x):
-    return _rotr(x, 19) ^ _rotr(x, 61) ^ (x >> np.uint64(6))
+    return _xor(_rotr(x, 19), _rotr(x, 61), _shr(x, 6))
 
 
-def _compress_block(state, words):
-    """One SHA-512 compression. state: 8-tuple of (...,) uint64;
-    words: (..., 16) uint64 big-endian message words."""
-    pad = jnp.zeros(words.shape[:-1] + (64,), jnp.uint64)
-    w0 = jnp.concatenate([words, pad], axis=-1)
+def _compress_block(state, whi, wlo):
+    """One SHA-512 compression. state: 8-tuple of (hi, lo) pairs of (...,)
+    uint32; whi/wlo: (..., 16) uint32 big-endian message word halves."""
+    pad = jnp.zeros(whi.shape[:-1] + (64,), jnp.uint32)
+    whi0 = jnp.concatenate([whi, pad], axis=-1)
+    wlo0 = jnp.concatenate([wlo, pad], axis=-1)
 
     def sched(t, w):
+        whi, wlo = w
+
         def at(i):
-            return lax.dynamic_index_in_dim(w, i, axis=-1, keepdims=False)
+            return (
+                lax.dynamic_index_in_dim(whi, i, axis=-1, keepdims=False),
+                lax.dynamic_index_in_dim(wlo, i, axis=-1, keepdims=False),
+            )
 
-        v = _small_sigma1(at(t - 2)) + at(t - 7) + _small_sigma0(at(t - 15)) + at(t - 16)
-        return lax.dynamic_update_index_in_dim(w, v, t, axis=-1)
+        v = _add64(
+            _add64(_small_sigma1(at(t - 2)), at(t - 7)),
+            _add64(_small_sigma0(at(t - 15)), at(t - 16)),
+        )
+        return (
+            lax.dynamic_update_index_in_dim(whi, v[0], t, axis=-1),
+            lax.dynamic_update_index_in_dim(wlo, v[1], t, axis=-1),
+        )
 
-    w = lax.fori_loop(16, 80, sched, w0)
-    kj = jnp.asarray(_K)
+    whi, wlo = lax.fori_loop(16, 80, sched, (whi0, wlo0))
+    khi = jnp.asarray(_K_HI)
+    klo = jnp.asarray(_K_LO)
 
     def rnd(t, st):
         a, b, c, d, e, f, g, h = st
-        kt = lax.dynamic_index_in_dim(kj, t, keepdims=False)
-        wt = lax.dynamic_index_in_dim(w, t, axis=-1, keepdims=False)
-        ch = (e & f) ^ (~e & g)
-        maj = (a & b) ^ (a & c) ^ (b & c)
-        t1 = h + _big_sigma1(e) + ch + kt + wt
-        t2 = _big_sigma0(a) + maj
-        return (t1 + t2, a, b, c, d + t1, e, f, g)
+        kt = (
+            lax.dynamic_index_in_dim(khi, t, keepdims=False),
+            lax.dynamic_index_in_dim(klo, t, keepdims=False),
+        )
+        wt = (
+            lax.dynamic_index_in_dim(whi, t, axis=-1, keepdims=False),
+            lax.dynamic_index_in_dim(wlo, t, axis=-1, keepdims=False),
+        )
+        ch = (e[0] & f[0]) ^ (~e[0] & g[0]), (e[1] & f[1]) ^ (~e[1] & g[1])
+        maj = (
+            (a[0] & b[0]) ^ (a[0] & c[0]) ^ (b[0] & c[0]),
+            (a[1] & b[1]) ^ (a[1] & c[1]) ^ (b[1] & c[1]),
+        )
+        t1 = _add64(
+            _add64(_add64(h, _big_sigma1(e)), _add64(ch, kt)), wt
+        )
+        t2 = _add64(_big_sigma0(a), maj)
+        return (_add64(t1, t2), a, b, c, _add64(d, t1), e, f, g)
 
     out = lax.fori_loop(0, 80, rnd, state)
-    return tuple(s + o for s, o in zip(state, out))
+    return tuple(_add64(s, o) for s, o in zip(state, out))
 
 
 def sha512(msg) -> jnp.ndarray:
@@ -120,18 +181,32 @@ def sha512(msg) -> jnp.ndarray:
         [msg, jnp.broadcast_to(jnp.asarray(pad), msg.shape[:-1] + (padlen,))],
         axis=-1,
     )
-    grouped = padded.reshape(msg.shape[:-1] + (nblocks, 16, 8)).astype(jnp.uint64)
-    shifts = jnp.asarray(np.arange(7, -1, -1, dtype=np.uint64) * 8)
-    words = jnp.sum(grouped << shifts, axis=-1)
+    # Big-endian 64-bit words as (hi, lo) uint32 halves: bytes 0-3 / 4-7.
+    grouped = padded.reshape(msg.shape[:-1] + (nblocks, 16, 2, 4)).astype(
+        jnp.uint32
+    )
+    shifts = jnp.asarray(np.arange(3, -1, -1, dtype=np.uint32) * 8)
+    halves = jnp.sum(grouped << shifts, axis=-1)  # (..., nblocks, 16, 2)
+    whi = halves[..., 0]
+    wlo = halves[..., 1]
 
     state = tuple(
-        jnp.broadcast_to(jnp.uint64(h), msg.shape[:-1]) for h in _H0
+        (
+            jnp.broadcast_to(jnp.uint32(hi), msg.shape[:-1]),
+            jnp.broadcast_to(jnp.uint32(lo), msg.shape[:-1]),
+        )
+        for hi, lo in zip(_H0_HI, _H0_LO)
     )
     for b in range(nblocks):
-        state = _compress_block(state, words[..., b, :])
+        state = _compress_block(state, whi[..., b, :], wlo[..., b, :])
 
-    out_shifts = jnp.asarray(np.arange(7, -1, -1, dtype=np.uint64) * 8)
+    out_shifts = jnp.asarray(np.arange(3, -1, -1, dtype=np.uint32) * 8)
     digest = jnp.stack(
-        [((s[..., None] >> out_shifts) & jnp.uint64(0xFF)) for s in state], axis=-2
+        [
+            ((half[..., None] >> out_shifts) & jnp.uint32(0xFF))
+            for s in state
+            for half in s
+        ],
+        axis=-2,
     )
     return digest.reshape(msg.shape[:-1] + (64,)).astype(jnp.uint8)

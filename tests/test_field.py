@@ -90,3 +90,26 @@ def test_scalar_bits():
     bits = np.asarray(F.scalar_bits(jnp.asarray(F.limbs_const(v))))
     for k in range(256):
         assert bits[k] == (v >> k) & 1
+
+
+def test_mul_hostile_bounds_no_overflow():
+    """Pin the int32 soundness window documented in field.py: mul must be
+    exact for limbs at the loosest magnitudes add/sub can produce
+    (|limb| < 2^10 signed). An int32 overflow anywhere in the columns or
+    the 38-fold would diverge from big-int ground truth."""
+    import itertools
+
+    patterns = [
+        np.full(F.NLIMBS, 1023, np.int32),
+        np.full(F.NLIMBS, -1023, np.int32),
+        np.array(
+            [1023 if i % 2 else -1023 for i in range(F.NLIMBS)], np.int32
+        ),
+    ]
+    for a, b in itertools.product(patterns, repeat=2):
+        want = (F.limbs_to_int(a) * F.limbs_to_int(b)) % F.P
+        for impl in (F._mul_schoolbook, F._mul_conv):
+            got = F.limbs_to_int(
+                np.asarray(F.canon(impl(jnp.asarray(a), jnp.asarray(b))))
+            )
+            assert got == want, f"{impl.__name__} overflowed"
