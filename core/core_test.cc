@@ -4,8 +4,11 @@
 // answers, canonical JSON, and a full in-process 4-replica consensus round
 // including a view change.
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <map>
+#include <memory>
+#include <set>
 #include <string>
 #include <vector>
 
@@ -96,6 +99,7 @@ struct MiniCluster {
   std::vector<std::vector<pbft::Message>> inboxes;
   std::vector<pbft::ClientReply> replies;
   pbft::CpuVerifier verifier;
+  std::set<int> crashed;  // crash-stop: no messages in or out
 
   explicit MiniCluster(const pbft::ClusterConfig& cfg,
                        const std::vector<std::vector<uint8_t>>& seeds) {
@@ -106,6 +110,7 @@ struct MiniCluster {
   }
 
   void emit(int src, pbft::Actions&& acts) {
+    if (crashed.count(src)) return;
     for (auto& b : acts.broadcasts) {
       for (int d = 0; d < 4; ++d) {
         if (d != src) route(d, b.msg);
@@ -116,6 +121,7 @@ struct MiniCluster {
   }
 
   void route(int dst, const pbft::Message& m) {
+    if (crashed.count(dst)) return;
     // byte-faithful hop
     auto back = pbft::from_payload(pbft::message_canonical(m));
     CHECK(back.has_value());
@@ -219,7 +225,16 @@ void test_stable_digest_majority_native() {
   std::vector<std::vector<uint8_t>> seeds;
   auto cfg = test_config(&seeds);
   MiniCluster c(cfg, seeds);
-  std::string good(64, 'a');
+  // The majority digest commits to a REAL checkpoint payload (the new
+  // state-transfer semantics: a watermark jump awaits the payload rather
+  // than adopting the digest blindly).
+  std::string good_chain(64, '0');
+  std::string good_payload = "{\"app\":\"\",\"chain\":\"" + good_chain +
+                             "\",\"replies\":[],\"seq\":10,\"timestamps\":[]}";
+  uint8_t gd[32];
+  pbft::blake2b_256(gd, (const uint8_t*)good_payload.data(),
+                    good_payload.size());
+  std::string good = pbft::to_hex(gd, 32);
   std::string evil(64, 'c');
   pbft::JsonArray proof;
   for (int i = 0; i < 4; ++i) {
@@ -246,8 +261,33 @@ void test_stable_digest_majority_native() {
     CHECK(c.replicas[i].view() == 1);
     CHECK(!c.replicas[i].in_view_change());
     CHECK(c.replicas[i].low_mark() == 10);
+    // The watermark jump must NOT silently skip executions: each replica
+    // awaits the payload certified by the MAJORITY digest.
+    CHECK(c.replicas[i].awaiting_state());
+    CHECK(c.replicas[i].executed_upto() == 0);
+  }
+  // A response with a tampered payload (hashing to something else — e.g.
+  // what the Byzantine first entry claimed) is refused; the certified
+  // payload completes recovery.
+  for (int i = 1; i < 4; ++i) {
+    pbft::StateResponse bad;
+    bad.seq = 10;
+    bad.snapshot = good_payload + " ";
+    bad.replica = 0;
+    c.route(i, pbft::Message(test_sign(bad, seeds[0])));
+    pbft::StateResponse sp;
+    sp.seq = 10;
+    sp.snapshot = good_payload;
+    sp.replica = 0;
+    c.route(i, pbft::Message(test_sign(sp, seeds[0])));
+  }
+  c.inboxes[0].clear();
+  c.run();
+  c.inboxes[0].clear();
+  for (int i = 1; i < 4; ++i) {
+    CHECK(!c.replicas[i].awaiting_state());
     CHECK(c.replicas[i].executed_upto() == 10);
-    CHECK(c.replicas[i].state_digest_hex() == good);
+    CHECK(c.replicas[i].state_digest_hex() == good_chain);
   }
   // New primary 1 assigns seq 11 (= max(low_mark, min_s) + 1), not 1.
   pbft::ClientRequest req;
@@ -260,6 +300,61 @@ void test_stable_digest_majority_native() {
   CHECK(pp && pp->seq == 11);
 }
 
+void test_state_transfer_native() {
+  // A lagging replica with a STATEFUL app fetches the certified checkpoint
+  // state (app snapshot + reply caches) and then serves matching replies —
+  // mirrors tests/test_state_transfer.py for the C++ runtime.
+  std::vector<std::vector<uint8_t>> seeds;
+  auto cfg = test_config(&seeds);
+  cfg.checkpoint_interval = 4;
+  MiniCluster c(cfg, seeds);
+  struct AppState {
+    int64_t total = 0;
+  };
+  std::vector<std::shared_ptr<AppState>> apps;
+  for (int i = 0; i < 4; ++i) {
+    auto st = std::make_shared<AppState>();
+    apps.push_back(st);
+    c.replicas[i].app_execute = [st](const std::string& op, int64_t) {
+      st->total += std::strtoll(op.c_str(), nullptr, 10);
+      return "total=" + std::to_string(st->total);
+    };
+    c.replicas[i].app_snapshot = [st] { return std::to_string(st->total); };
+    c.replicas[i].app_restore = [st](const std::string& s) {
+      st->total = s.empty() ? 0 : std::strtoll(s.c_str(), nullptr, 10);
+    };
+  }
+  auto submit = [&](int value, int64_t ts) {
+    pbft::ClientRequest req;
+    req.operation = std::to_string(value);
+    req.timestamp = ts;
+    req.client = "127.0.0.1:9999";
+    c.emit(0, c.replicas[0].on_client_request(req));
+    c.run();
+  };
+  c.crashed.insert(3);  // replica 3 misses a stretch spanning a checkpoint
+  for (int i = 0; i < 6; ++i) submit(i + 1, i + 1);
+  CHECK(c.replicas[0].executed_upto() == 6);
+  CHECK(c.replicas[0].low_mark() == 4);
+  CHECK(c.replicas[3].executed_upto() == 0);
+  c.crashed.erase(3);
+  for (int i = 6; i < 10; ++i) submit(i + 1, i + 1);
+  CHECK(c.replicas[3].counters["state_transfers"] >= 1);
+  CHECK(!c.replicas[3].awaiting_state());
+  CHECK(c.replicas[3].executed_upto() == 10);
+  CHECK(c.replicas[3].state_digest_hex() == c.replicas[0].state_digest_hex());
+  CHECK(apps[3]->total == apps[0]->total);
+  CHECK(apps[3]->total == 55);
+  // The recovered replica serves replies matching the quorum.
+  size_t before = c.replies.size();
+  submit(100, 11);
+  int matching = 0;
+  for (size_t i = before; i < c.replies.size(); ++i) {
+    if (c.replies[i].result == "total=155") ++matching;
+  }
+  CHECK(matching == 4);
+}
+
 }  // namespace
 
 int main() {
@@ -270,6 +365,7 @@ int main() {
   test_four_replica_commit();
   test_view_change_native();
   test_stable_digest_majority_native();
+  test_state_transfer_native();
   if (g_failures) {
     std::fprintf(stderr, "%d failure(s)\n", g_failures);
     return 1;

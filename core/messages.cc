@@ -127,6 +127,25 @@ Json NewView::to_json() const {
   return Json(std::move(o));
 }
 
+Json StateRequest::to_json() const {
+  JsonObject o;
+  o.emplace("replica", replica);
+  o.emplace("seq", seq);
+  o.emplace("sig", sig);
+  o.emplace("type", "state-request");
+  return Json(std::move(o));
+}
+
+Json StateResponse::to_json() const {
+  JsonObject o;
+  o.emplace("replica", replica);
+  o.emplace("seq", seq);
+  o.emplace("sig", sig);
+  o.emplace("snapshot", snapshot);
+  o.emplace("type", "state-response");
+  return Json(std::move(o));
+}
+
 MsgType type_of(const Message& m) {
   return static_cast<MsgType>(m.index());
 }
@@ -224,6 +243,20 @@ std::optional<Message> message_from_json(const Json& j) {
       return std::nullopt;
     r.checkpoint_proof = cp->as_array();
     r.prepared_proofs = pp->as_array();
+    return Message(std::move(r));
+  }
+  if (type == "state-request") {
+    StateRequest r;
+    if (!get_int(j, "seq", &r.seq) || !get_int(j, "replica", &r.replica) ||
+        !get_str(j, "sig", &r.sig))
+      return std::nullopt;
+    return Message(std::move(r));
+  }
+  if (type == "state-response") {
+    StateResponse r;
+    if (!get_int(j, "seq", &r.seq) || !get_str(j, "snapshot", &r.snapshot) ||
+        !get_int(j, "replica", &r.replica) || !get_str(j, "sig", &r.sig))
+      return std::nullopt;
     return Message(std::move(r));
   }
   if (type == "new-view") {

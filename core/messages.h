@@ -26,6 +26,8 @@ enum class MsgType {
   kCheckpoint,
   kViewChange,
   kNewView,
+  kStateRequest,
+  kStateResponse,
 };
 
 struct ClientRequest {
@@ -116,8 +118,33 @@ struct NewView {
   Json to_json() const;
 };
 
-using Message = std::variant<ClientRequest, ClientReply, PrePrepare, Prepare,
-                             Commit, Checkpoint, ViewChange, NewView>;
+// <STATE-REQUEST, n, i>: a replica whose watermark jumped past its
+// execution asks peers for the checkpoint payload at stable sequence n
+// (PBFT §5.3 state transfer; the reference TODO'd even the watermark
+// checks, reference src/behavior.rs:154,:192).
+struct StateRequest {
+  int64_t seq = 0;
+  int64_t replica = 0;
+  std::string sig;
+
+  Json to_json() const;
+};
+
+// <STATE-RESPONSE, n, payload, i>: the canonical checkpoint payload at n
+// (app snapshot + chain digest + reply caches). Content is trusted only if
+// its Blake2b-256 digest equals the 2f+1-certified stable checkpoint digest.
+struct StateResponse {
+  int64_t seq = 0;
+  std::string snapshot;
+  int64_t replica = 0;
+  std::string sig;
+
+  Json to_json() const;
+};
+
+using Message =
+    std::variant<ClientRequest, ClientReply, PrePrepare, Prepare, Commit,
+                 Checkpoint, ViewChange, NewView, StateRequest, StateResponse>;
 
 MsgType type_of(const Message& m);
 Json message_to_json(const Message& m);

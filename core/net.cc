@@ -203,9 +203,16 @@ void ReplicaServer::process_buffer(Conn& c) {
         payload.swap(c.rbuf);
       } else {
         // Wait for more bytes — but try a complete object eagerly so a
-        // no-newline sender (telnet paste) still goes through.
+        // no-newline sender (telnet paste) still goes through. Bounded:
+        // a line larger than 1 MiB on this unauthenticated socket is a
+        // protocol violation and drops the connection (the framed path
+        // caps at 2^24 below; the raw path must not buffer without bound).
         if (Json::parse(c.rbuf)) {
           payload.swap(c.rbuf);
+        } else if (c.rbuf.size() > (1u << 20)) {
+          close(c.fd);
+          c.closed = true;
+          return;
         } else {
           return;
         }
@@ -357,6 +364,22 @@ void ReplicaServer::check_progress_timer() {
       ++it;
     }
   }
+  if (replica_->awaiting_state()) {
+    // A lagging replica waiting on state transfer retries the fetch on the
+    // timer — a view change would not help it catch up. Dedicated deadline:
+    // the VC timer may hold a stale backed-off deadline.
+    timer_armed_ = false;
+    if (!state_timer_armed_) {
+      state_timer_armed_ = true;
+      state_timer_deadline_ = now + std::chrono::milliseconds(vc_timeout_ms_);
+      return;
+    }
+    if (now < state_timer_deadline_) return;
+    emit(replica_->retry_state_transfer());
+    state_timer_armed_ = false;
+    return;
+  }
+  state_timer_armed_ = false;
   bool pending = !waiting_requests_.empty() || replica_->has_unexecuted();
   if (!pending) {
     timer_armed_ = false;

@@ -107,6 +107,11 @@ class ReplicaServer {
   bool timer_armed_ = false;
   int timer_backoff_ = 1;
   std::chrono::steady_clock::time_point timer_deadline_{};
+  // State-transfer retry keeps its own deadline: the view-change timer may
+  // hold a stale backed-off deadline (up to 64x vc_timeout) that must not
+  // delay the first fetch retry.
+  bool state_timer_armed_ = false;
+  std::chrono::steady_clock::time_point state_timer_deadline_{};
   int64_t timer_exec_snapshot_ = 0;
   int64_t timer_view_snapshot_ = 0;
   // Forwarded-but-unreplied client requests: (client addr, timestamp).

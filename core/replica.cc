@@ -51,7 +51,7 @@ Replica::Replica(ClusterConfig config, int64_t replica_id,
   for (const char* name :
        {"sig_verified", "sig_rejected", "pre_prepares_accepted",
         "prepares_accepted", "commits_accepted", "executed",
-        "duplicate_requests", "checkpoints_stable"}) {
+        "duplicate_requests", "checkpoints_stable", "state_transfers"}) {
     counters[name] = 0;
   }
 }
@@ -111,6 +111,8 @@ int64_t replica_of(const Message& m) {
   if (auto* cp = std::get_if<Checkpoint>(&m)) return cp->replica;
   if (auto* vc = std::get_if<ViewChange>(&m)) return vc->replica;
   if (auto* nv = std::get_if<NewView>(&m)) return nv->replica;
+  if (auto* sr = std::get_if<StateRequest>(&m)) return sr->replica;
+  if (auto* sp = std::get_if<StateResponse>(&m)) return sp->replica;
   return -1;
 }
 const std::string* sig_of(const Message& m) {
@@ -120,6 +122,8 @@ const std::string* sig_of(const Message& m) {
   if (auto* cp = std::get_if<Checkpoint>(&m)) return &cp->sig;
   if (auto* vc = std::get_if<ViewChange>(&m)) return &vc->sig;
   if (auto* nv = std::get_if<NewView>(&m)) return &nv->sig;
+  if (auto* sr = std::get_if<StateRequest>(&m)) return &sr->sig;
+  if (auto* sp = std::get_if<StateResponse>(&m)) return &sp->sig;
   return nullptr;
 }
 ClientRequest null_request() {
@@ -175,6 +179,9 @@ Actions Replica::dispatch(const Message& msg) {
   if (auto* cp = std::get_if<Checkpoint>(&msg)) return on_checkpoint(*cp);
   if (auto* vc = std::get_if<ViewChange>(&msg)) return on_view_change(*vc);
   if (auto* nv = std::get_if<NewView>(&msg)) return on_new_view(*nv);
+  if (auto* sr = std::get_if<StateRequest>(&msg)) return on_state_request(*sr);
+  if (auto* sp = std::get_if<StateResponse>(&msg))
+    return on_state_response(*sp);
   if (auto* r = std::get_if<ClientRequest>(&msg)) return on_client_request(*r);
   return {};
 }
@@ -315,8 +322,10 @@ Actions Replica::drain_executions() {
         counters["duplicate_requests"] += 1;
       } else {
         // Execution: the reference's app is a no-op returning "awesome!"
-        // (reference src/message.rs:70); kept as the built-in app.
-        std::string result = "awesome!";
+        // (reference src/message.rs:70); kept as the built-in default —
+        // a stateful app overrides via the app_execute hook.
+        std::string result =
+            app_execute ? app_execute(req.operation, seq) : "awesome!";
         counters["executed"] += 1;
         {
           std::vector<uint8_t> buf(state_digest_, state_digest_ + 32);
@@ -337,15 +346,118 @@ Actions Replica::drain_executions() {
       }
     }
     if (seq % config_.checkpoint_interval == 0) {
+      std::string payload = checkpoint_payload(seq);
+      snapshots_[seq] = payload;
+      uint8_t d[32];
+      blake2b_256(d, (const uint8_t*)payload.data(), payload.size());
       Checkpoint cp;
       cp.seq = seq;
-      cp.digest = to_hex(state_digest_, 32);
+      cp.digest = to_hex(d, 32);
       cp.replica = id_;
       cp = sign(cp);
       out.broadcasts.push_back({Message(cp)});
       out.merge(insert_checkpoint(cp));
     }
   }
+  return out;
+}
+
+std::string Replica::checkpoint_payload(int64_t seq) const {
+  // Canonical JSON the checkpoint digest commits to: app snapshot, the
+  // execution chain digest, and the per-client exactly-once caches.
+  // Byte-identical to Replica._checkpoint_payload in the Python runtime —
+  // the digest gates state transfer across runtimes. The reply cache's
+  // `replica` field is normalized to -1 so all correct replicas digest
+  // identical bytes (the restorer stamps its own id back in).
+  JsonObject o;
+  o.emplace("app", app_snapshot ? app_snapshot() : std::string());
+  o.emplace("chain", to_hex(state_digest_, 32));
+  JsonArray replies;
+  for (const auto& [client, reply] : last_reply_) {  // std::map: sorted
+    Json rj = reply.to_json();
+    rj.as_object()["replica"] = Json((int64_t)-1);
+    replies.push_back(Json(JsonArray{Json(client), std::move(rj)}));
+  }
+  o.emplace("replies", Json(std::move(replies)));
+  o.emplace("seq", seq);
+  JsonArray timestamps;
+  for (const auto& [client, ts] : last_timestamp_) {
+    timestamps.push_back(Json(JsonArray{Json(client), Json(ts)}));
+  }
+  o.emplace("timestamps", Json(std::move(timestamps)));
+  return Json(std::move(o)).dump();
+}
+
+Actions Replica::on_state_request(const StateRequest& sr) {
+  auto it = snapshots_.find(sr.seq);
+  if (it == snapshots_.end() || sr.replica < 0 || sr.replica >= config_.n())
+    return {};
+  StateResponse resp;
+  resp.seq = sr.seq;
+  resp.snapshot = it->second;
+  resp.replica = id_;
+  resp = sign(resp);
+  Actions out;
+  out.sends.push_back({sr.replica, Message(resp)});
+  return out;
+}
+
+Actions Replica::on_state_response(const StateResponse& resp) {
+  if (!awaiting_state_ || resp.seq != awaiting_state_->first) return {};
+  uint8_t d[32];
+  blake2b_256(d, (const uint8_t*)resp.snapshot.data(), resp.snapshot.size());
+  if (to_hex(d, 32) != awaiting_state_->second) return {};  // not certified
+  auto j = Json::parse(resp.snapshot);
+  if (!j || !j->is_object()) return {};
+  const Json* app = j->find("app");
+  const Json* chain = j->find("chain");
+  const Json* replies = j->find("replies");
+  const Json* timestamps = j->find("timestamps");
+  if (!app || !app->is_string() || !chain || !chain->is_string() ||
+      !replies || !replies->is_array() || !timestamps ||
+      !timestamps->is_array())
+    return {};
+  uint8_t chain_bytes[32];
+  if (!from_hex(chain->as_string(), chain_bytes, 32)) return {};
+  std::map<std::string, ClientReply> new_replies;
+  for (const Json& entry : replies->as_array()) {
+    if (!entry.is_array() || entry.as_array().size() != 2) return {};
+    const Json& client = entry.as_array()[0];
+    auto msg = message_from_json(entry.as_array()[1]);
+    if (!client.is_string() || !msg) return {};
+    auto* reply = std::get_if<ClientReply>(&*msg);
+    if (!reply) return {};
+    ClientReply r = *reply;
+    r.replica = id_;
+    new_replies.emplace(client.as_string(), std::move(r));
+  }
+  std::map<std::string, int64_t> new_timestamps;
+  for (const Json& entry : timestamps->as_array()) {
+    if (!entry.is_array() || entry.as_array().size() != 2) return {};
+    const Json& client = entry.as_array()[0];
+    const Json& ts = entry.as_array()[1];
+    if (!client.is_string() || !ts.is_int()) return {};
+    new_timestamps.emplace(client.as_string(), ts.as_int());
+  }
+  if (app_restore) app_restore(app->as_string());
+  std::memcpy(state_digest_, chain_bytes, 32);
+  last_reply_ = std::move(new_replies);
+  last_timestamp_ = std::move(new_timestamps);
+  executed_upto_ = resp.seq;
+  snapshots_[resp.seq] = resp.snapshot;  // we can serve peers now
+  awaiting_state_.reset();
+  counters["state_transfers"] += 1;
+  return drain_executions();
+}
+
+Actions Replica::retry_state_transfer() {
+  if (!awaiting_state_) return {};
+  StateRequest sr;
+  sr.seq = awaiting_state_->first;
+  sr.replica = id_;
+  sr = sign(sr);
+  Actions out;
+  out.broadcasts.push_back({Message(sr)});
   return out;
 }
 
@@ -360,6 +472,7 @@ Actions Replica::insert_checkpoint(const Checkpoint& cp) {
   slot.emplace(cp.replica, cp);
   std::map<std::string, int64_t> by_digest;
   for (const auto& [rid, c] : slot) by_digest[c.digest] += 1;
+  Actions out;
   for (const auto& [d, count] : by_digest) {
     if (count >= 2 * config_.f() + 1) {
       // Keep the 2f+1 matching checkpoint messages: they are the C
@@ -368,28 +481,33 @@ Actions Replica::insert_checkpoint(const Checkpoint& cp) {
       for (const auto& [rid, c] : slot) {
         if (c.digest == d) proof.push_back(c.to_json());
       }
-      advance_watermark(cp.seq, d);
+      out.merge(advance_watermark(cp.seq, d));
       stable_proof_ = std::move(proof);
       break;
     }
   }
-  return {};
+  return out;
 }
 
-void Replica::advance_watermark(int64_t stable_seq,
-                                const std::string& stable_digest) {
-  if (stable_seq <= low_mark_) return;
+Actions Replica::advance_watermark(int64_t stable_seq,
+                                   const std::string& stable_digest) {
+  if (stable_seq <= low_mark_) return {};
   low_mark_ = stable_seq;
   counters["checkpoints_stable"] += 1;
+  Actions out;
   if (stable_seq > executed_upto_) {
-    // State-transfer-lite: 2f+1 replicas proved execution through
-    // stable_seq with this digest; adopt it instead of waiting for
-    // messages the pruning below is about to delete (that wait would
-    // deadlock execution forever). Full state transfer (fetching app
-    // state + per-client reply caches) is the complete recovery; the
-    // default app is stateless so adopting the digest is sufficient.
-    executed_upto_ = stable_seq;
-    from_hex(stable_digest, state_digest_, 32);
+    // We missed executions that 2f+1 replicas checkpointed, and the
+    // pruning below deletes the messages that would replay them: fetch
+    // the certified checkpoint state from a peer (PBFT §5.3). Execution
+    // stalls (executed_upto_ stays) until a StateResponse whose payload
+    // hashes to stable_digest arrives; the net layer re-broadcasts the
+    // request on its progress timer.
+    awaiting_state_ = {stable_seq, stable_digest};
+    StateRequest sr;
+    sr.seq = stable_seq;
+    sr.replica = id_;
+    sr = sign(sr);
+    out.broadcasts.push_back({Message(sr)});
   }
   auto prune_keys = [stable_seq](auto& log) {
     for (auto it = log.begin(); it != log.end();) {
@@ -412,6 +530,11 @@ void Replica::advance_watermark(int64_t stable_seq,
     if (it->first <= stable_seq) it = pending_execution_.erase(it);
     else ++it;
   }
+  for (auto it = snapshots_.begin(); it != snapshots_.end();) {
+    if (it->first < stable_seq) it = snapshots_.erase(it);
+    else ++it;
+  }
+  return out;
 }
 
 // -- view change (PBFT §4.4) --------------------------------------------
@@ -729,8 +852,9 @@ Actions Replica::enter_new_view(int64_t v, int64_t min_s,
     if (it->first <= v) it = view_changes_.erase(it);
     else ++it;
   }
+  Actions out;
   if (min_s > low_mark_ && stable_digest) {
-    advance_watermark(min_s, *stable_digest);
+    out.merge(advance_watermark(min_s, *stable_digest));
   }
   // The new primary continues the sequence after the re-issued slots.
   // low_mark is included: when this replica's stable checkpoint is ahead of
@@ -753,7 +877,6 @@ Actions Replica::enter_new_view(int64_t v, int64_t min_s,
   prune_old_views(pre_prepares_);
   prune_old_views(prepares_);
   prune_old_views(commits_);
-  Actions out;
   for (const auto& pp : pps) out.merge(on_pre_prepare(pp));
   return out;
 }

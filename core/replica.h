@@ -12,9 +12,12 @@
 
 #include <cstdint>
 #include <deque>
+#include <functional>
 #include <map>
+#include <optional>
 #include <set>
 #include <string>
+#include <utility>
 #include <vector>
 
 #include "messages.h"
@@ -96,6 +99,20 @@ class Replica {
   // Metrics (SURVEY.md §5: first-class counters, not printf).
   std::map<std::string, int64_t> counters;
 
+  // Optional stateful-app hooks (PBFT §5.3 state transfer). Defaults keep
+  // the reference's no-op app ("awesome!", reference src/message.rs:70)
+  // with an empty snapshot. A stateful app sets all three; its snapshot is
+  // embedded in the checkpoint payload that the 2f+1-certified checkpoint
+  // digest commits to, and restored on state transfer.
+  std::function<std::string(const std::string&, int64_t)> app_execute;
+  std::function<std::string()> app_snapshot;
+  std::function<void(const std::string&)> app_restore;
+
+  // State transfer status + runtime retry hook (net layer re-broadcasts
+  // the request on its progress timer instead of starting a view change).
+  bool awaiting_state() const { return awaiting_state_.has_value(); }
+  Actions retry_state_transfer();
+
  private:
   using Key = std::pair<int64_t, int64_t>;  // (view, seq)
 
@@ -114,7 +131,13 @@ class Replica {
   Actions drain_executions();
   Actions on_checkpoint(const Checkpoint& cp);
   Actions insert_checkpoint(const Checkpoint& cp);
-  void advance_watermark(int64_t stable_seq, const std::string& stable_digest);
+  Actions advance_watermark(int64_t stable_seq,
+                            const std::string& stable_digest);
+  // Canonical checkpoint payload (byte-identical to the Python runtime's
+  // Replica._checkpoint_payload) + the state-transfer handlers.
+  std::string checkpoint_payload(int64_t seq) const;
+  Actions on_state_request(const StateRequest& sr);
+  Actions on_state_response(const StateResponse& resp);
 
   // View change internals (mirrors pbft_tpu/consensus/replica.py; hot-path
   // signatures are batch-verified, rare view-change evidence inline).
@@ -159,6 +182,10 @@ class Replica {
   std::map<std::string, ClientReply> last_reply_;
   std::map<int64_t, std::map<int64_t, Checkpoint>> checkpoints_;
   std::deque<Message> inbox_;
+  // Checkpoint payloads we can serve to lagging peers, and the
+  // (seq, digest) we are ourselves waiting to fetch after a watermark jump.
+  std::map<int64_t, std::string> snapshots_;
+  std::optional<std::pair<int64_t, std::string>> awaiting_state_;
 
   bool in_view_change_ = false;
   int64_t pending_view_ = 0;

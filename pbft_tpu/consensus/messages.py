@@ -252,5 +252,33 @@ class Checkpoint(Message):
     sig: str = ""
 
 
+@dataclasses.dataclass(frozen=True)
+class StateRequest(Message):
+    """<STATE-REQUEST, n, i>: a replica whose watermark jumped past its
+    execution asks peers for the checkpoint payload at stable sequence n
+    (PBFT §5.3 state-transfer analogue; the reference TODO'd even the
+    watermark checks, src/behavior.rs:154,:192)."""
+
+    TYPE: ClassVar[str] = "state-request"
+    seq: int
+    replica: int
+    sig: str = ""
+
+
+@dataclasses.dataclass(frozen=True)
+class StateResponse(Message):
+    """<STATE-RESPONSE, n, payload, i>: the canonical checkpoint payload at
+    sequence n (app snapshot + chain digest + per-client reply caches,
+    see Replica._checkpoint_payload). The receiver trusts it only if its
+    Blake2b-256 digest equals the 2f+1-certified stable checkpoint digest —
+    the sender's signature gates transport, the digest gates content."""
+
+    TYPE: ClassVar[str] = "state-response"
+    seq: int
+    snapshot: str
+    replica: int
+    sig: str = ""
+
+
 def with_sig(msg: Message, sig_hex: str) -> Message:
     return dataclasses.replace(msg, sig=sig_hex)

@@ -42,7 +42,10 @@ from .messages import (
     NewView,
     Prepare,
     PrePrepare,
+    StateRequest,
+    StateResponse,
     ViewChange,
+    _canonical_json,
     blake2b_256,
     null_request,
     with_sig,
@@ -75,6 +78,13 @@ def default_app(operation: str, seq: int) -> str:
     return "awesome!"
 
 
+# Apps may optionally be *stateful*: any callable with ``snapshot() -> str``
+# and ``restore(s: str) -> None`` attributes participates in state transfer
+# (PBFT §5.3) — its snapshot is embedded in the checkpoint payload that the
+# 2f+1-certified checkpoint digest commits to. A bare callable (like
+# default_app) is treated as stateless (empty snapshot).
+
+
 class Replica:
     def __init__(
         self,
@@ -103,6 +113,11 @@ class Replica:
         self.checkpoints: Dict[int, Dict[int, Checkpoint]] = {}
         self.state_digest = blake2b_256(b"pbft-genesis")
         self.stable_proof: List[dict] = []  # 2f+1 checkpoint dicts @ low_mark
+        # Checkpoint payloads we can serve to lagging peers (seq -> canonical
+        # JSON, see _checkpoint_payload), and the (seq, digest) we are
+        # ourselves waiting to fetch after a watermark jump.
+        self.snapshots: Dict[int, str] = {}
+        self.awaiting_state: Optional[Tuple[int, str]] = None
         # View change (PBFT §4.4; the reference had no view mutation at all,
         # reference src/view.rs:1-13).
         self.in_view_change = False
@@ -121,6 +136,7 @@ class Replica:
             "checkpoints_stable": 0,
             "view_changes_started": 0,
             "view_changes_completed": 0,
+            "state_transfers": 0,
         }
 
     # -- identity helpers ---------------------------------------------------
@@ -237,6 +253,10 @@ class Replica:
             return self._on_view_change(msg)
         if isinstance(msg, NewView):
             return self._on_new_view(msg)
+        if isinstance(msg, StateRequest):
+            return self._on_state_request(msg)
+        if isinstance(msg, StateResponse):
+            return self._on_state_response(msg)
         if isinstance(msg, ClientRequest):
             return self.on_client_request(msg)
         return []
@@ -364,9 +384,10 @@ class Replica:
             self.executed_upto = seq
             pp = self.pre_prepares.get((view, seq))
             if pp is None:
-                # Watermark advanced past this seq (others checkpointed it);
-                # recovering the missed execution needs state transfer, which
-                # is a later-round capability — skip safely.
+                # Defensive: can only happen if the pre-prepare log lost an
+                # entry for a slot that committed; the watermark-jump path
+                # (the old way to get here) now goes through state transfer
+                # (_on_state_response) instead of skipping executions.
                 continue
             req = pp.request
             if req.client == NULL_CLIENT:
@@ -402,14 +423,99 @@ class Replica:
                     self.last_reply[req.client] = reply
                     out.append(Reply(req.client, reply))
             if seq % self.config.checkpoint_interval == 0:
+                payload = self._checkpoint_payload(seq)
+                self.snapshots[seq] = payload
                 cp = self._sign(
-                    Checkpoint(seq=seq, digest=self.state_digest.hex(), replica=self.id)
+                    Checkpoint(
+                        seq=seq,
+                        digest=blake2b_256(payload.encode()).hex(),
+                        replica=self.id,
+                    )
                 )
                 out.append(Broadcast(cp))
                 out.extend(self._insert_checkpoint(cp))
         return out
 
-    # -- checkpoints & watermarks (PBFT §4.3) -------------------------------
+    # -- checkpoints, watermarks & state transfer (PBFT §4.3, §5.3) ---------
+
+    def _app_snapshot(self) -> str:
+        snap = getattr(self._app, "snapshot", None)
+        return snap() if callable(snap) else ""
+
+    def _checkpoint_payload(self, seq: int) -> str:
+        """Canonical JSON the checkpoint digest commits to: app snapshot,
+        the execution chain digest, and the per-client exactly-once caches.
+        Byte-identical across the Python and C++ runtimes (sorted keys,
+        compact separators) — the digest gates state transfer, so both
+        runtimes must serialize the same bytes for the same state."""
+        obj = {
+            "app": self._app_snapshot(),
+            "chain": self.state_digest.hex(),
+            # The reply cache is replica-local only in its `replica` field;
+            # normalize it to -1 so all correct replicas digest identical
+            # payload bytes (the restorer stamps its own id back in).
+            "replies": [
+                [c, {**self.last_reply[c].to_dict(), "replica": -1}]
+                for c in sorted(self.last_reply)
+            ],
+            "seq": seq,
+            "timestamps": [
+                [c, self.last_timestamp[c]] for c in sorted(self.last_timestamp)
+            ],
+        }
+        return _canonical_json(obj).decode()
+
+    def retry_state_transfer(self) -> List[Action]:
+        """Re-broadcast the pending StateRequest (runtime retry timer)."""
+        if self.awaiting_state is None:
+            return []
+        seq, _ = self.awaiting_state
+        return [Broadcast(self._sign(StateRequest(seq=seq, replica=self.id)))]
+
+    def _on_state_request(self, sr: StateRequest) -> List[Action]:
+        payload = self.snapshots.get(sr.seq)
+        if payload is None or not (0 <= sr.replica < self.config.n):
+            return []
+        resp = self._sign(
+            StateResponse(seq=sr.seq, snapshot=payload, replica=self.id)
+        )
+        return [Send(sr.replica, resp)]
+
+    def _on_state_response(self, resp: StateResponse) -> List[Action]:
+        if self.awaiting_state is None:
+            return []
+        seq, digest = self.awaiting_state
+        if resp.seq != seq:
+            return []
+        if blake2b_256(resp.snapshot.encode()).hex() != digest:
+            return []  # content not certified by the 2f+1 checkpoint quorum
+        try:
+            import json as _json
+
+            obj = _json.loads(resp.snapshot)
+            replies = {
+                c: dataclasses.replace(
+                    Message.from_dict(dict(d)), replica=self.id
+                )
+                for c, d in obj["replies"]
+            }
+            if not all(isinstance(r, ClientReply) for r in replies.values()):
+                return []
+            timestamps = {c: int(t) for c, t in obj["timestamps"]}
+            chain = bytes.fromhex(obj["chain"])
+        except (KeyError, TypeError, ValueError):
+            return []
+        restore = getattr(self._app, "restore", None)
+        if callable(restore):
+            restore(obj.get("app", ""))
+        self.state_digest = chain
+        self.last_reply = replies
+        self.last_timestamp = timestamps
+        self.executed_upto = seq
+        self.snapshots[seq] = resp.snapshot  # we can serve peers now
+        self.awaiting_state = None
+        self.counters["state_transfers"] += 1
+        return self._drain_executions()
 
     def _on_checkpoint(self, cp: Checkpoint) -> List[Action]:
         if cp.seq <= self.low_mark:
@@ -424,6 +530,7 @@ class Replica:
         by_digest: Dict[str, int] = {}
         for c in slot.values():
             by_digest[c.digest] = by_digest.get(c.digest, 0) + 1
+        out: List[Action] = []
         for digest, count in by_digest.items():
             if count >= 2 * self.config.f + 1:
                 # Keep the 2f+1 matching checkpoint messages: they are the
@@ -431,10 +538,10 @@ class Replica:
                 proof = [
                     c.to_dict() for c in slot.values() if c.digest == digest
                 ]
-                self._advance_watermark(cp.seq, digest)
+                out.extend(self._advance_watermark(cp.seq, digest))
                 self.stable_proof = proof
                 break
-        return []
+        return out
 
     # -- view change (PBFT §4.4) -------------------------------------------
     #
@@ -727,8 +834,9 @@ class Replica:
         self.counters["view_changes_completed"] += 1
         for past in [w for w in self.view_changes if w <= v]:
             del self.view_changes[past]
+        out: List[Action] = []
         if min_s > self.low_mark and stable_digest is not None:
-            self._advance_watermark(min_s, stable_digest)
+            out.extend(self._advance_watermark(min_s, stable_digest))
         # The new primary continues the sequence after the re-issued slots;
         # harmless for backups (their seq_counter is unused until they lead).
         # low_mark is included: when this replica's stable checkpoint is
@@ -745,25 +853,31 @@ class Replica:
         for log in (self.pre_prepares, self.prepares, self.commits):
             for key in [k for k in log if k[0] < v and k[1] not in reissued]:
                 del log[key]
-        out: List[Action] = []
         for pp in pps:
             out.extend(self._on_pre_prepare(pp))
         return out
 
-    def _advance_watermark(self, stable_seq: int, stable_digest: str) -> None:
+    def _advance_watermark(
+        self, stable_seq: int, stable_digest: str
+    ) -> List[Action]:
         if stable_seq <= self.low_mark:
-            return
+            return []
         self.low_mark = stable_seq
         self.counters["checkpoints_stable"] += 1
+        out: List[Action] = []
         if stable_seq > self.executed_upto:
-            # State-transfer-lite: 2f+1 replicas proved execution through
-            # stable_seq with this digest; adopt it instead of waiting for
-            # messages the pruning below deletes (that wait would deadlock
-            # execution forever on a lagging replica). Full state transfer
-            # (app state + per-client reply caches) is the complete
-            # recovery; the default app is stateless so this suffices.
-            self.executed_upto = stable_seq
-            self.state_digest = bytes.fromhex(stable_digest)
+            # We missed executions that 2f+1 replicas checkpointed, and the
+            # pruning below deletes the messages that would replay them:
+            # fetch the certified checkpoint state from a peer (PBFT §5.3).
+            # Execution stalls (executed_upto stays) until a StateResponse
+            # whose payload hashes to stable_digest arrives; the runtime
+            # re-broadcasts the request on its retry timer.
+            self.awaiting_state = (stable_seq, stable_digest)
+            out.append(
+                Broadcast(
+                    self._sign(StateRequest(seq=stable_seq, replica=self.id))
+                )
+            )
         for log in (self.pre_prepares, self.prepares, self.commits):
             for key in [k for k in log if k[1] <= stable_seq]:
                 del log[key]
@@ -772,3 +886,6 @@ class Replica:
             del self.checkpoints[seq]
         for seq in [s for s in self.pending_execution if s <= stable_seq]:
             del self.pending_execution[seq]
+        for seq in [s for s in self.snapshots if s < stable_seq]:
+            del self.snapshots[seq]
+        return out

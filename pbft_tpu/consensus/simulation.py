@@ -40,13 +40,22 @@ class Cluster:
         config: Optional[ClusterConfig] = None,
         seeds: Optional[List[bytes]] = None,
         app=None,
+        app_factory: Optional[Callable[[], Callable]] = None,
     ):
         if config is None:
             config, seeds = make_local_cluster(n)
         self.config = config
+
+        def _app_kw():
+            # app_factory gives each replica its OWN app instance — required
+            # for stateful apps (state transfer tests); a bare `app` is
+            # shared, fine for stateless callables.
+            if app_factory is not None:
+                return {"app": app_factory()}
+            return {"app": app} if app else {}
+
         self.replicas = [
-            Replica(config, i, seeds[i], **({"app": app} if app else {}))
-            for i in range(config.n)
+            Replica(config, i, seeds[i], **_app_kw()) for i in range(config.n)
         ]
         self.inboxes: Dict[int, List[Message]] = {i: [] for i in range(config.n)}
         self.client_replies: List[ClientReply] = []
@@ -147,6 +156,12 @@ class Cluster:
         for other in range(self.config.n):
             self.dropped_links.add((replica_id, other))
             self.dropped_links.add((other, replica_id))
+
+    def uncrash(self, replica_id: int) -> None:
+        """Heal every link to and from the replica (recovery after crash)."""
+        for other in range(self.config.n):
+            self.dropped_links.discard((replica_id, other))
+            self.dropped_links.discard((other, replica_id))
 
     def trigger_view_change(self, replica_ids=None, new_view=None) -> None:
         """Fire the (runtime-owned) request timers: each listed replica

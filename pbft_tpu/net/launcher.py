@@ -81,6 +81,7 @@ class LocalCluster:
         self.impl = [impl] * self.config.n if isinstance(impl, str) else list(impl)
         self.procs: List[subprocess.Popen] = []
         self.tmpdir: Optional[tempfile.TemporaryDirectory] = None
+        self._cmds: List[tuple] = []  # (cmd, env) per replica, for revive()
 
     def __enter__(self) -> "LocalCluster":
         import random
@@ -127,6 +128,7 @@ class LocalCluster:
                 cmd += ["--vc-timeout-ms", str(self.vc_timeout_ms)]
             if self.discovery:
                 cmd += ["--discovery", self._discovery_target]
+            self._cmds.append((cmd, env))
             self.procs.append(
                 subprocess.Popen(
                     cmd, stdout=log, stderr=log, close_fds=True, env=env
@@ -198,6 +200,17 @@ class LocalCluster:
         """Crash-stop one replica (fault injection: PBFT tolerates f)."""
         self.procs[replica_id].terminate()
         self.procs[replica_id].wait(timeout=5)
+
+    def revive(self, replica_id: int) -> None:
+        """Restart a killed replica with FRESH state (recovery scenario:
+        it must catch up via checkpoints + state transfer, PBFT §5.3)."""
+        cmd, env = self._cmds[replica_id]
+        log = open(
+            Path(self.tmpdir.name) / f"replica-{replica_id}.log", "ab"
+        )
+        self.procs[replica_id] = subprocess.Popen(
+            cmd, stdout=log, stderr=log, close_fds=True, env=env
+        )
 
     def __exit__(self, *exc) -> None:
         for p in self.procs:

@@ -78,6 +78,7 @@ class AsyncReplicaServer:
         # Progress timer state (mirrors core/net.cc check_progress_timer).
         self._waiting_requests: Dict[Tuple[str, int], float] = {}
         self._timer_deadline: Optional[float] = None
+        self._state_retry_deadline: Optional[float] = None
         self._timer_snapshot = (0, 0)  # (executed_upto, view)
         self._timer_backoff = 1
 
@@ -121,19 +122,40 @@ class AsyncReplicaServer:
         finally:
             writer.close()
 
+    # A raw-JSON client line may not exceed this; longer input is a
+    # protocol violation (or an attack) and drops the connection instead
+    # of buffering without bound.
+    MAX_CLIENT_LINE = 1 << 20
+
+    def _ingest_client_line(self, line: bytes) -> None:
+        line = line.strip()
+        if not line:
+            return
+        try:
+            msg = from_wire(line)
+        except (ValueError, KeyError, json.JSONDecodeError):
+            return
+        self._ingest(msg)
+
     async def _client_connection(self, first: bytes, reader) -> None:
-        # Raw JSON, one message per line / per connection (telnet-able,
-        # like the reference's gateway).
-        data = first + await reader.read(65536)
-        for line in data.splitlines():
-            line = line.strip()
-            if not line:
+        # Raw JSON, one message per line (telnet-able, like the reference's
+        # gateway). Proper line buffering: requests larger than one read()
+        # are reassembled, and a line above MAX_CLIENT_LINE drops the
+        # connection (bounded buffering on an unauthenticated socket).
+        buf = first
+        while True:
+            nl = buf.find(b"\n")
+            if nl >= 0:
+                line, buf = buf[:nl], buf[nl + 1 :]
+                self._ingest_client_line(line)
                 continue
-            try:
-                msg = from_wire(line)
-            except (ValueError, KeyError, json.JSONDecodeError):
-                continue
-            self._ingest(msg)
+            if len(buf) > self.MAX_CLIENT_LINE:
+                return  # oversized line: drop the connection
+            chunk = await reader.read(65536)
+            if not chunk:
+                break
+            buf += chunk
+        self._ingest_client_line(buf)  # trailing JSON without newline
 
     async def _peer_connection(self, first: bytes, reader) -> None:
         buf = first
@@ -255,6 +277,18 @@ class AsyncReplicaServer:
                 if now - t > 10 * self.vc_timeout
             ]:
                 del self._waiting_requests[key]
+            if self.replica.awaiting_state is not None:
+                # A lagging replica waiting on state transfer retries the
+                # fetch once per vc_timeout (mirrors core/net.cc) — a view
+                # change would not help it catch up.
+                self._timer_deadline = None
+                if self._state_retry_deadline is None:
+                    self._state_retry_deadline = now + self.vc_timeout
+                elif now >= self._state_retry_deadline:
+                    self._emit(self.replica.retry_state_transfer())
+                    self._state_retry_deadline = None
+                continue
+            self._state_retry_deadline = None
             pending = bool(self._waiting_requests) or self.replica.has_unexecuted()
             if not pending:
                 self._timer_deadline = None

@@ -142,3 +142,67 @@ def test_remote_verifier_service_path():
         assert svc.items > 0
     finally:
         svc.stop()
+
+
+def test_mixed_cluster_recovery_via_state_transfer():
+    """Kill a py replica, commit past a checkpoint, revive it with FRESH
+    state: it must catch up by fetching the certified checkpoint payload
+    from its (C++) peers (PBFT §5.3). A mixed 2cxx+2py cluster can only
+    form the checkpoint quorum if both runtimes digest byte-identical
+    payloads, so this doubles as the cross-runtime state-parity test."""
+    import json
+    import time
+    from pathlib import Path
+
+    from pbft_tpu.consensus.config import ClusterConfig, make_local_cluster
+    from pbft_tpu.net.launcher import free_ports
+
+    config, seeds = make_local_cluster(4, base_port=0)
+    ports = free_ports(4)
+    config = ClusterConfig(
+        replicas=[
+            type(r)(r.replica_id, r.host, ports[i], r.pubkey)
+            for i, r in enumerate(config.replicas)
+        ],
+        checkpoint_interval=4,
+    )
+    with LocalCluster(
+        config=config,
+        seeds=seeds,
+        impl=["cxx", "cxx", "py", "py"],
+        metrics_every=1,
+        vc_timeout_ms=400,
+        verifier="cpu",
+    ) as cluster:
+        client = PbftClient(cluster.config)
+        try:
+            cluster.kill(3)
+            for i in range(6):
+                req = client.request(f"while-down-{i}")
+                assert client.wait_result(req.timestamp, timeout=20) == "awesome!"
+            cluster.revive(3)
+            cluster._wait_listening()  # checkpoint broadcasts must reach it
+            for i in range(4):
+                req = client.request(f"after-revive-{i}")
+                assert client.wait_result(req.timestamp, timeout=20) == "awesome!"
+            # Replica 3's metrics stream must show a completed transfer.
+            log = Path(cluster.tmpdir.name) / "replica-3.log"
+            deadline = time.monotonic() + 25
+            seen = None
+            while time.monotonic() < deadline:
+                for line in log.read_text(errors="replace").splitlines():
+                    try:
+                        m = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue
+                    if m.get("state_transfers", 0) >= 1 and m.get(
+                        "executed_upto", 0
+                    ) >= 8:
+                        seen = m
+                        break
+                if seen:
+                    break
+                time.sleep(0.5)
+            assert seen, f"replica 3 never caught up via state transfer\n{cluster.logs()}"
+        finally:
+            client.close()

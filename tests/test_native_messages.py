@@ -15,6 +15,8 @@ from pbft_tpu.consensus.messages import (
     NewView,
     Prepare,
     PrePrepare,
+    StateRequest,
+    StateResponse,
     ViewChange,
 )
 
@@ -63,6 +65,15 @@ MESSAGES = [
         pre_prepares=(_PP.to_dict(),),
         replica=1,
         sig="44" * 64,
+    ),
+    StateRequest(seq=16, replica=3, sig="55" * 64),
+    StateResponse(
+        seq=16,
+        # A checkpoint payload is itself canonical JSON carried as a string
+        # field — the parity test covers its escaping both ways.
+        snapshot='{"app":"7 ☃","chain":"00","replies":[],"seq":16,"timestamps":[["c:1",5]]}',
+        replica=2,
+        sig="66" * 64,
     ),
 ]
 

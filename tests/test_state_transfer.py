@@ -1,0 +1,114 @@
+"""State transfer (PBFT §5.3): a lagging replica fetches the certified
+checkpoint state — app snapshot, chain digest, per-client reply caches —
+from a peer and verifies it against the 2f+1 stable checkpoint digest,
+instead of silently skipping missed executions (the round-2 gap: the old
+watermark jump adopted the digest only, which was correct solely for
+stateless apps)."""
+
+from pbft_tpu.consensus.config import make_local_cluster
+from pbft_tpu.consensus.messages import StateResponse, blake2b_256
+from pbft_tpu.consensus.replica import Replica
+from pbft_tpu.consensus.simulation import Cluster
+
+
+class CounterApp:
+    """Stateful app: every result depends on all prior operations, so a
+    replica that skipped executions would produce diverging replies."""
+
+    def __init__(self):
+        self.total = 0
+
+    def __call__(self, operation: str, seq: int) -> str:
+        self.total += int(operation)
+        return f"total={self.total}"
+
+    def snapshot(self) -> str:
+        return str(self.total)
+
+    def restore(self, s: str) -> None:
+        self.total = int(s) if s else 0
+
+
+def make_cluster() -> Cluster:
+    config, seeds = make_local_cluster(4)
+    config.checkpoint_interval = 4
+    return Cluster(config=config, seeds=seeds, app_factory=CounterApp)
+
+
+def test_lagging_replica_catches_up_with_stateful_app():
+    c = make_cluster()
+    c.crash(3)  # replica 3 misses a stretch spanning a checkpoint
+    for i in range(6):
+        c.submit(str(i + 1))
+        c.run()
+    for rid in (0, 1, 2):
+        assert c.replicas[rid].executed_upto == 6
+        assert c.replicas[rid].low_mark == 4
+    assert c.replicas[3].executed_upto == 0
+
+    # Heal; new traffic produces the next stable checkpoint, which replica 3
+    # learns about, triggering the fetch.
+    c.uncrash(3)
+    for i in range(6, 10):
+        c.submit(str(i + 1))
+        c.run()
+    r3 = c.replicas[3]
+    assert r3.counters["state_transfers"] >= 1
+    assert r3.awaiting_state is None
+    assert r3.executed_upto == c.replicas[0].executed_upto == 10
+    assert r3.state_digest == c.replicas[0].state_digest
+    assert r3._app.total == c.replicas[0]._app.total == sum(range(1, 11))
+
+    # The recovered replica now serves replies that MATCH the quorum —
+    # the whole point of transferring app state.
+    t = c.submit("100")
+    c.run()
+    result = c.committed_result(t.timestamp)
+    replies3 = [
+        r
+        for r in c.client_replies
+        if r.replica == 3 and r.timestamp == t.timestamp
+    ]
+    assert replies3 and all(r.result == result for r in replies3)
+
+
+def test_exactly_once_cache_transfers():
+    """A duplicate of a request executed while the replica was down must be
+    answered from the TRANSFERRED reply cache, not re-executed."""
+    c = make_cluster()
+    c.crash(3)
+    for i in range(6):
+        c.submit(str(i + 1))
+        c.run()
+    c.uncrash(3)
+    for i in range(6, 10):
+        c.submit(str(i + 1))
+        c.run()
+    r3 = c.replicas[3]
+    assert r3.counters["state_transfers"] >= 1
+    # Replay timestamp 2 (executed during the outage) directly at replica 3.
+    dup = c.submit("2", timestamp=2, to_replica=3)
+    c.run()
+    assert r3.last_timestamp[dup.client] >= 2
+    assert r3._app.total == c.replicas[0]._app.total  # no double-execution
+
+
+def test_tampered_state_response_rejected():
+    """A response whose payload does not hash to the certified digest is
+    ignored — a Byzantine peer cannot inject bogus state."""
+    config, seeds = make_local_cluster(4)
+    config.checkpoint_interval = 4
+    r = Replica(config, 3, seeds[3], app=CounterApp())
+    good = '{"app":"7","chain":"%s","replies":[],"seq":4,"timestamps":[]}' % (
+        "00" * 32
+    )
+    digest = blake2b_256(good.encode()).hex()
+    r.awaiting_state = (4, digest)
+    evil = good.replace('"7"', '"9"')
+    r._on_state_response(StateResponse(seq=4, snapshot=evil, replica=1))
+    assert r.awaiting_state == (4, digest)  # still waiting, nothing adopted
+    assert r._app.total == 0
+    r._on_state_response(StateResponse(seq=4, snapshot=good, replica=1))
+    assert r.awaiting_state is None
+    assert r._app.total == 7
+    assert r.executed_upto == 4
