@@ -42,10 +42,27 @@ def initialize_distributed(
     """jax.distributed.initialize with explicit args or env-var discovery.
 
     No-op when jax.distributed is already initialized or when running a
-    single process (num_processes == 1)."""
-    if jax.process_count() > 1:
-        return  # already initialized
-    if num_processes in (None, 1) and coordinator_address is None:
+    single process (num_processes == 1 and no cluster env markers). The
+    already-initialized check must NOT touch jax.process_count()/
+    jax.devices(): those initialize the XLA backend, after which
+    jax.distributed.initialize() refuses to run."""
+    import os
+
+    if jax.distributed.is_initialized():
+        return
+    # Env-var discovery: jax's own coordinator variables mark a multi-host
+    # launch even when the caller passes no explicit args (e.g. a launcher
+    # exports them per host). Single-process is only assumed when neither
+    # explicit args nor these markers are present.
+    env_discovery = any(
+        os.environ.get(k)
+        for k in ("JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES")
+    )
+    if (
+        num_processes in (None, 1)
+        and coordinator_address is None
+        and not env_discovery
+    ):
         return  # single-process deployment: nothing to do
     jax.distributed.initialize(
         coordinator_address=coordinator_address,

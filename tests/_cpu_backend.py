@@ -1,0 +1,48 @@
+"""Shared force-CPU setup for test processes (conftest + subprocess workers).
+
+Two subtleties of this environment (see conftest.py): a sitecustomize hook
+registers the TPU PJRT plugin at interpreter startup, and the virtual
+multi-device CPU mesh needs XLA_FLAGS set before backend init. Subprocess
+workers (e.g. tests/multihost_worker.py) can't rely on conftest running, so
+the logic lives here once.
+"""
+
+import os
+import re
+
+
+def force_cpu(n_devices: int = 8, compile_cache: bool = True) -> None:
+    """Point THIS process at an n-device virtual CPU backend.
+
+    Must run before the first jax backend touch. Idempotent.
+    """
+    flags = re.sub(
+        r"--xla_force_host_platform_device_count=\d+\s*",
+        "",
+        os.environ.get("XLA_FLAGS", ""),
+    ).strip()
+    os.environ["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count={n_devices}"
+    ).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    if compile_cache:
+        # Persistent compilation cache: the crypto kernels are
+        # compile-heavy; caching cuts repeat runs from minutes to seconds.
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            os.path.join(os.path.dirname(__file__), "..", ".jax_cache"),
+        )
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    try:  # drop non-cpu plugin factories registered before we ran
+        from jax._src import xla_bridge
+
+        for name in list(getattr(xla_bridge, "_backend_factories", {})):
+            if name != "cpu":
+                xla_bridge._backend_factories.pop(name)
+    except Exception:  # pragma: no cover - jax internals may move
+        pass

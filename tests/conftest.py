@@ -12,35 +12,16 @@ Two subtleties of this environment:
    mirroring the driver's dryrun of __graft_entry__.dryrun_multichip) needs
    XLA_FLAGS before backend init too.
 
-The TPU path itself is exercised by bench.py / __graft_entry__.py, not by
-unit tests.
+The logic lives in tests/_cpu_backend.py so subprocess workers (which never
+see conftest) share it. The TPU path itself is exercised by bench.py /
+__graft_entry__.py, not by unit tests.
 """
 
 import os
+import sys
 
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
-os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, os.path.dirname(__file__))
 
-import jax
+from _cpu_backend import force_cpu
 
-jax.config.update("jax_platforms", "cpu")
-# Persistent compilation cache: the crypto kernels are compile-heavy (256-step
-# ladders); caching cuts repeat suite runs from minutes to seconds.
-jax.config.update(
-    "jax_compilation_cache_dir",
-    os.path.join(os.path.dirname(__file__), "..", ".jax_cache"),
-)
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
-try:  # drop non-cpu plugin factories registered before conftest ran
-    from jax._src import xla_bridge
-
-    for _name in list(getattr(xla_bridge, "_backend_factories", {})):
-        if _name != "cpu":
-            xla_bridge._backend_factories.pop(_name)
-except Exception:  # pragma: no cover - jax internals may move
-    pass
+force_cpu(n_devices=8)

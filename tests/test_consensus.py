@@ -315,6 +315,7 @@ def test_lagging_replica_adopts_stable_checkpoint():
     assert r3.state_digest == c.replicas[0].state_digest
 
 
+@pytest.mark.slow  # compiles the batch verifier inside the sim (~3 min cold)
 def test_jax_verifier_cluster_equivalence():
     """Same scenario through the JAX batch verifier: identical outcome
     (SURVEY.md §7 'determinism at the FFI boundary')."""

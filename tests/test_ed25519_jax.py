@@ -18,6 +18,9 @@ from pbft_tpu.crypto import ed25519 as E
 from pbft_tpu.crypto import field as F
 from tests.test_crypto_ref import RFC8032_VECTORS
 
+# Kernel-compile-heavy: slow tier (pytest -m slow).
+pytestmark = pytest.mark.slow
+
 # jit wrappers: eager-mode dispatch of the limb arithmetic is far too slow
 # for tests; compile once per shape and reuse.
 _jit_verify = jax.jit(E.verify_kernel)

@@ -14,6 +14,9 @@ from pbft_tpu.crypto import ref
 from pbft_tpu.crypto.batch import pad_batch
 from pbft_tpu.parallel import make_mesh, sharded_verify, quorum_certify, round_step
 
+# Kernel-compile-heavy: slow tier (pytest -m slow).
+pytestmark = pytest.mark.slow
+
 
 def _signed_items(count, bad=()):
     items = []
