@@ -16,9 +16,7 @@ from pbft_tpu.net.server import AsyncReplicaServer
 
 
 def _run(coro):
-    return asyncio.get_event_loop_policy().new_event_loop().run_until_complete(
-        coro
-    )
+    return asyncio.run(coro)
 
 
 def test_py_client_line_reassembled_across_reads():
@@ -61,11 +59,16 @@ def test_py_oversized_client_line_dropped():
         server = await AsyncReplicaServer(config, 0, seeds[0]).start()
         try:
             r, w = await asyncio.open_connection("127.0.0.1", server.listen_port)
-            w.write(b"{" + b"x" * (server.MAX_CLIENT_LINE + 4096))
-            await w.drain()
-            # Server must close on us (not buffer forever).
-            data = await asyncio.wait_for(r.read(), timeout=10)
-            assert data == b""
+            # The server closes mid-send once its buffer limit trips, which
+            # can surface here as a reset rather than clean EOF — both mean
+            # "dropped", which is what this test asserts.
+            try:
+                w.write(b"{" + b"x" * (server.MAX_CLIENT_LINE + 4096))
+                await w.drain()
+                data = await asyncio.wait_for(r.read(), timeout=10)
+                assert data == b""
+            except ConnectionError:
+                pass
             # And still serve a normal request afterwards.
             req = {
                 "type": "client-request",
@@ -99,18 +102,23 @@ def test_cxx_oversized_client_line_dropped():
     with LocalCluster(n=4, verifier="cpu") as cluster:
         ident = cluster.config.replicas[0]
         with socket.create_connection((ident.host, ident.port), timeout=5) as s:
-            s.sendall(b"{" + b"y" * ((1 << 20) + 4096))
-            s.settimeout(10)
-            # The daemon must close the connection (recv -> b"").
-            deadline = time.monotonic() + 10
+            # The daemon closes mid-send once its buffer limit trips; the
+            # in-flight tail then surfaces as ECONNRESET/EPIPE on our side —
+            # equivalent to the clean-EOF case for this test's purposes.
             closed = False
-            while time.monotonic() < deadline:
-                try:
-                    if s.recv(4096) == b"":
-                        closed = True
+            try:
+                s.sendall(b"{" + b"y" * ((1 << 20) + 4096))
+                s.settimeout(10)
+                deadline = time.monotonic() + 10
+                while time.monotonic() < deadline:
+                    try:
+                        if s.recv(4096) == b"":
+                            closed = True
+                            break
+                    except socket.timeout:
                         break
-                except socket.timeout:
-                    break
+            except OSError:
+                closed = True
             assert closed, "pbftd kept the oversized connection open"
         client = PbftClient(cluster.config)
         try:
