@@ -45,20 +45,11 @@ _ONE = F.limbs_const(1)
 _ZERO = F.limbs_const(0)
 
 # [0]B, [1]B, [2]B, [3]B in extended coords (X, Y, Z=1, T=XY) — the static
-# row of the Shamir table, precomputed from the oracle so the ladder never
-# spends traced point ops on base multiples.
-_B2 = ref.point_add(ref.BASE, ref.BASE)
-_B3 = ref.point_add(_B2, ref.BASE)
+# row of the Shamir table (ref.shamir_row0, shared with pallas_kernels),
+# precomputed so the ladder never spends traced point ops on base multiples.
 _ROW0 = tuple(
     np.stack([F.limbs_const(v) for v in coords])
-    for coords in zip(
-        *(
-            (0, 1, 1, 0),  # identity
-            (ref.BASE[0], ref.BASE[1], 1, ref.BASE[0] * ref.BASE[1] % F.P),
-            (_B2[0], _B2[1], 1, _B2[0] * _B2[1] % F.P),
-            (_B3[0], _B3[1], 1, _B3[0] * _B3[1] % F.P),
-        )
-    )
+    for coords in zip(*ref.shamir_row0())
 )  # 4 arrays of shape (4, 32): X-row, Y-row, Z-row, T-row
 
 
@@ -110,12 +101,50 @@ def point_neg(p):
     return (F.neg(x), y, z, F.neg(t))
 
 
+def _use_pallas() -> bool:
+    """PBFT_PALLAS=1 routes the three long multiply chains (pow_p58, inv,
+    Shamir ladder) through the fused Pallas kernels in pallas_kernels.py.
+    Read at trace time — set it before the first verify of a given batch
+    shape. Off-TPU the kernels would run under the Pallas INTERPRETER —
+    orders of magnitude slower than the XLA path — so a non-TPU backend
+    ignores the flag unless PBFT_PALLAS_INTERPRET=1 explicitly opts in
+    (equivalence tests do)."""
+    import os
+
+    if os.environ.get("PBFT_PALLAS") != "1":
+        return False
+    if os.environ.get("PBFT_PALLAS_INTERPRET") == "1":
+        return True
+    import jax
+
+    try:
+        return jax.default_backend() in ("tpu", "axon")
+    except Exception:
+        return False
+
+
+def _impl_pow_p58(z):
+    if _use_pallas():
+        from . import pallas_kernels
+
+        return pallas_kernels.pow_p58(z)
+    return F.pow_p58(z)
+
+
+def _impl_inv(z):
+    if _use_pallas():
+        from . import pallas_kernels
+
+        return pallas_kernels.inv(z)
+    return F.inv(z)
+
+
 def sqrt_ratio(u, v):
     """(ok, r) with v*r^2 == u when ok; the p = 5 (mod 8) method."""
     v2 = F.sqr(v)
     v3 = F.mul(v, v2)
     v7 = F.mul(v3, F.sqr(v2))
-    r = F.mul(F.mul(u, v3), F.pow_p58(F.mul(u, v7)))
+    r = F.mul(F.mul(u, v3), _impl_pow_p58(F.mul(u, v7)))
     check = F.mul(v, F.sqr(r))
     ok_plus = F.eq(check, u)
     ok_minus = F.eq(check, F.neg(u))
@@ -150,7 +179,7 @@ def decompress(ybytes):
 def compress(p):
     """Point -> (…,32) uint8 canonical encoding."""
     x, y, z, _ = p
-    zi = F.inv(z)
+    zi = _impl_inv(z)
     xa = F.canon(F.mul(x, zi))
     ybytes = F.limbs_to_bytes(F.mul(y, zi))
     sign = (xa[..., 0] & 1).astype(jnp.uint8)
@@ -230,7 +259,14 @@ def verify_kernel(pub, msg, sig):
     s = F.bytes_to_limbs(s_bytes)
     s_ok = F.scalar_lt_l(s)
     ok_a, a_pt = decompress(pub)
-    p = shamir_ladder(F.scalar_bits(s), F.scalar_bits(h), point_neg(a_pt))
+    if _use_pallas():
+        from . import pallas_kernels
+
+        p = pallas_kernels.ladder(
+            F.scalar_bits(s), F.scalar_bits(h), point_neg(a_pt)
+        )
+    else:
+        p = shamir_ladder(F.scalar_bits(s), F.scalar_bits(h), point_neg(a_pt))
     enc = compress(p)
     match = jnp.all(enc == r_bytes, axis=-1)
     return ok_a & s_ok & match

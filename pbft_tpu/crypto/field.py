@@ -222,12 +222,17 @@ def pow2k(x, k: int):
     return lax.fori_loop(0, k, _sqr_body, x)
 
 
-def _inv_chain(z):
+def _inv_chain(z, mul=None, sqr=None, pow2k=None):
     """Shared ladder: returns (z^(2^250-1), z^11).
 
     The classic curve25519 exponent chain; pieces are reused by both inv()
     (exponent p-2 = 2^255-21) and pow_p58() (exponent (p-5)/8 = 2^252-3).
-    """
+    The ops are parameters so pallas_kernels runs the IDENTICAL chain with
+    its in-kernel primitives — one definition, two backends (divergence
+    between verifier backends would split replicas)."""
+    mul = mul or globals()["mul"]
+    sqr = sqr or globals()["sqr"]
+    pow2k = pow2k or globals()["pow2k"]
     z2 = sqr(z)
     z8 = pow2k(z2, 2)
     z9 = mul(z, z8)

@@ -82,6 +82,19 @@ def point_add(a: Tuple[int, int], b: Tuple[int, int]) -> Tuple[int, int]:
     return x3 % P, y3 % P
 
 
+def shamir_row0() -> list:
+    """[0]B..[3]B as (x, y, z=1, t=xy) ints: the static h=0 row of the
+    verifier's Shamir table. Single source for BOTH verifier backends
+    (ed25519.py XLA path and pallas_kernels.py) — two copies that drift
+    would split replicas."""
+    b2 = point_add(BASE, BASE)
+    b3 = point_add(b2, BASE)
+    rows = [(0, 1, 1, 0)]
+    for p in (BASE, b2, b3):
+        rows.append((p[0], p[1], 1, p[0] * p[1] % P))
+    return rows
+
+
 _D2 = 2 * D % P
 
 

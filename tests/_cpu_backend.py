@@ -38,6 +38,13 @@ def force_cpu(n_devices: int = 8, compile_cache: bool = True) -> None:
         )
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    try:  # pallas registers MLIR lowerings for the 'tpu' platform at
+        # import, which only succeeds while the TPU plugin factory is
+        # still registered — import it BEFORE dropping factories so later
+        # (interpret-mode) imports hit sys.modules.
+        import jax.experimental.pallas  # noqa: F401
+    except Exception:  # pragma: no cover - pallas absent in minimal jax
+        pass
     try:  # drop non-cpu plugin factories registered before we ran
         from jax._src import xla_bridge
 
