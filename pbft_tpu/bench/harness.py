@@ -137,10 +137,86 @@ def run_config(
     )
 
 
+def run_native_config(
+    index: int, requests: Optional[int] = None
+) -> BenchResult:
+    """The same config driven through REAL pbftd processes over loopback
+    TCP (framed wire protocol, dial-back replies) instead of the in-memory
+    lockstep simulation — the deployment-shaped number. The Byzantine
+    config is simulation-only (its signature mutator hooks the in-memory
+    transport), so index 4 is rejected here."""
+    import re
+    import threading
+    from pathlib import Path
+
+    from ..net import LocalCluster, PbftClient
+
+    name, n, clients, default_requests, byzantine = CONFIGS[index]
+    if byzantine:
+        raise ValueError("byzantine config is simulation-only (use --arm cpu/jax)")
+    # The native runtime pipelines across rounds, so give it enough
+    # requests to measure steady state even on the demo config.
+    reqs_total = requests or max(default_requests, 100)
+    per_client = max(1, reqs_total // clients)
+    reqs_total = per_client * clients
+    with LocalCluster(n=n, verifier="cpu", metrics_every=1) as cluster:
+        f_val = cluster.config.f
+        handles = [PbftClient(cluster.config) for _ in range(clients)]
+        warm = handles[0].request("warmup")
+        handles[0].wait_result(warm.timestamp, timeout=30)
+        t0 = time.perf_counter()
+
+        def drive(ci: int) -> None:
+            c = handles[ci]
+            for k in range(per_client):
+                req = c.request(f"op-{ci}-{k}")
+                c.wait_result(req.timestamp, timeout=60)
+
+        threads = [
+            threading.Thread(target=drive, args=(i,)) for i in range(clients)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        elapsed = time.perf_counter() - t0
+        for c in handles:
+            c.close()
+        # Total signature verifications across the cluster, from each
+        # replica's last metrics line (core/net.cc metrics_json).
+        sig_total = 0
+        time.sleep(1.5)  # one more metrics tick so counters are current
+        for i in range(n):
+            log = (Path(cluster.tmpdir.name) / f"replica-{i}.log").read_text(
+                errors="ignore"
+            )
+            found = re.findall(r'"sig_verified":(\d+)', log)
+            if found:
+                sig_total += int(found[-1])
+    return BenchResult(
+        config=name,
+        replicas=n,
+        f=f_val,
+        clients=clients,
+        requests=reqs_total,
+        seconds=round(elapsed, 3),
+        rounds_per_sec=round(reqs_total / elapsed, 1),
+        sig_verifies_per_sec=round(sig_total / elapsed, 1),
+        sig_verifications=sig_total,
+        verifier="native",
+        byzantine=False,
+    )
+
+
 def run_all(arm: str = "cpu", out_path: Optional[str] = None) -> List[BenchResult]:
     results = []
     for i in range(len(CONFIGS)):
-        res = run_config(i, arm=arm)
+        if arm == "native":
+            if CONFIGS[i][4]:
+                continue  # byzantine config is simulation-only
+            res = run_native_config(i)
+        else:
+            res = run_config(i, arm=arm)
         print(res.to_json(), flush=True)
         results.append(res)
     if out_path:
@@ -154,13 +230,20 @@ def main() -> None:
     import argparse
 
     parser = argparse.ArgumentParser(description=__doc__)
-    parser.add_argument("--arm", default="cpu", choices=["cpu", "jax"])
+    parser.add_argument("--arm", default="cpu", choices=["cpu", "jax", "native"])
     parser.add_argument("--config", type=int, default=None, help="0-4; default all")
     parser.add_argument("--requests", type=int, default=None)
     parser.add_argument("--out", default=None)
     args = parser.parse_args()
     if args.config is not None:
-        print(run_config(args.config, arm=args.arm, requests=args.requests).to_json())
+        if args.arm == "native":
+            print(run_native_config(args.config, requests=args.requests).to_json())
+        else:
+            print(
+                run_config(
+                    args.config, arm=args.arm, requests=args.requests
+                ).to_json()
+            )
     else:
         run_all(arm=args.arm, out_path=args.out)
 

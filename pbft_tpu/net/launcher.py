@@ -55,7 +55,9 @@ class LocalCluster:
         discovery: bool = False,
         config: Optional[ClusterConfig] = None,
         seeds: Optional[List[bytes]] = None,
+        trace_dir: Optional[str] = None,
     ):
+        self.trace_dir = trace_dir
         self.discovery = discovery
         if config is None:
             config, seeds = make_local_cluster(n, base_port=0)
@@ -128,6 +130,8 @@ class LocalCluster:
                 cmd += ["--vc-timeout-ms", str(self.vc_timeout_ms)]
             if self.discovery:
                 cmd += ["--discovery", self._discovery_target]
+            if self.trace_dir:
+                cmd += ["--trace", str(Path(self.trace_dir) / f"replica-{i}.jsonl")]
             self._cmds.append((cmd, env))
             self.procs.append(
                 subprocess.Popen(
