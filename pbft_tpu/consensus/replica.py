@@ -72,6 +72,27 @@ class Reply:
 Action = object  # Send | Broadcast | Reply
 
 
+_HOST_SIGNER = None
+
+
+def _host_sign(seed: bytes, msg: bytes) -> bytes:
+    """Host-side message signing: the native C++ signer when built (~36 us),
+    else the pure-Python oracle (~4 ms). The two are byte-identical (RFC
+    8032 deterministic signatures; parity pinned by
+    tests/test_native_crypto.py), so the choice cannot diverge replicas."""
+    global _HOST_SIGNER
+    if _HOST_SIGNER is None:
+        _HOST_SIGNER = crypto.sign
+        try:
+            from .. import native
+
+            if native.available():
+                _HOST_SIGNER = native.sign
+        except Exception:  # pragma: no cover - unbuilt native core
+            pass
+    return _HOST_SIGNER(seed, msg)
+
+
 def default_app(operation: str, seq: int) -> str:
     """The reference's execution is a no-op with a hardcoded result
     (reference src/message.rs:70); kept as the default app."""
@@ -162,7 +183,7 @@ class Replica:
         return any(seq > self.executed_upto for _, seq in self.pre_prepares)
 
     def _sign(self, msg: Message) -> Message:
-        return with_sig(msg, crypto.sign(self._seed, msg.signable()).hex())
+        return with_sig(msg, _host_sign(self._seed, msg.signable()).hex())
 
     # -- client request path (reference src/behavior.rs:63-98) --------------
 
