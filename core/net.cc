@@ -12,6 +12,7 @@
 #include <cerrno>
 #include <cstdio>
 #include <cstring>
+#include <type_traits>
 
 namespace pbft {
 
@@ -428,14 +429,37 @@ int ReplicaServer::peer_fd(int64_t dest) {
   return fd;
 }
 
+namespace {
+template <class T, class = void>
+struct has_sig : std::false_type {};
+template <class T>
+struct has_sig<T, std::void_t<decltype(std::declval<T&>().sig)>>
+    : std::true_type {};
+
+// The Byzantine signer's outgoing message: same content, garbage
+// signature (mirrors the simulation mutator in bench/harness.py).
+Message corrupt_sig(Message m) {
+  std::visit(
+      [](auto& v) {
+        if constexpr (has_sig<std::decay_t<decltype(v)>>::value) {
+          if (!v.sig.empty()) v.sig.assign(v.sig.size(), 'f');
+        }
+      },
+      m);
+  return m;
+}
+}  // namespace
+
 void ReplicaServer::send_to(int64_t dest, const Message& m) {
   if (dest == id_) {
+    // Self-delivery bypasses the wire AND the corruption: a Byzantine
+    // signer trusts its own messages; only its peers see garbage.
     emit(replica_->receive(m));
     return;
   }
   if (peer_fd(dest) < 0) return;  // peer down: PBFT tolerates f of these
   Conn& c = *peers_[dest];
-  c.wbuf += to_wire(m);
+  c.wbuf += to_wire(byzantine_ ? corrupt_sig(m) : m);
   flush(c);
 }
 

@@ -77,6 +77,12 @@ class ReplicaServer {
   // closes any previously set sink.
   bool set_trace_file(const std::string& path);
 
+  // Fault injection: corrupt the signature of every outgoing protocol
+  // message (the BASELINE config-5 Byzantine signer, as a real daemon
+  // instead of a simulation mutator). Honest replicas must reject the
+  // garbage signatures and commit without this replica's votes.
+  void set_byzantine(bool b) { byzantine_ = b; }
+
  private:
   void accept_ready();
   void handle_readable(Conn& c);
@@ -105,6 +111,7 @@ class ReplicaServer {
   std::chrono::steady_clock::time_point last_beacon_{};
   int vc_timeout_ms_ = 0;
   bool timer_armed_ = false;
+  bool byzantine_ = false;
   int timer_backoff_ = 1;
   std::chrono::steady_clock::time_point timer_deadline_{};
   // State-transfer retry keeps its own deadline: the view-change timer may

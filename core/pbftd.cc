@@ -35,6 +35,7 @@ int main(int argc, char** argv) {
   int64_t id = -1;
   int metrics_every = 0;
   int vc_timeout_ms = 0;
+  bool byzantine = false;
   for (int i = 1; i < argc; ++i) {
     std::string a = argv[i];
     auto next = [&]() -> const char* { return i + 1 < argc ? argv[++i] : ""; };
@@ -46,6 +47,7 @@ int main(int argc, char** argv) {
     else if (a == "--vc-timeout-ms") vc_timeout_ms = std::atoi(next());
     else if (a == "--discovery") discovery = next();
     else if (a == "--trace") trace_path = next();
+    else if (a == "--byzantine") byzantine = true;
     else {
       std::fprintf(stderr, "unknown arg: %s\n", a.c_str());
       return 2;
@@ -90,6 +92,7 @@ int main(int argc, char** argv) {
 
   pbft::ReplicaServer server(*cfg, id, seed, std::move(verifier));
   if (vc_timeout_ms > 0) server.set_view_change_timeout(vc_timeout_ms);
+  if (byzantine) server.set_byzantine(true);
   if (!discovery.empty()) server.enable_discovery(discovery);
   if (!trace_path.empty()) server.set_trace_file(trace_path);
   if (!server.start()) {

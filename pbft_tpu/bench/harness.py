@@ -143,8 +143,8 @@ def run_native_config(
     """The same config driven through REAL pbftd processes over loopback
     TCP (framed wire protocol, dial-back replies) instead of the in-memory
     lockstep simulation — the deployment-shaped number. The Byzantine
-    config is simulation-only (its signature mutator hooks the in-memory
-    transport), so index 4 is rejected here."""
+    config runs replica n-1 with pbftd --byzantine (every outgoing
+    signature corrupted); the honest 2f+1 must carry every round."""
     import re
     import threading
     from pathlib import Path
@@ -152,14 +152,17 @@ def run_native_config(
     from ..net import LocalCluster, PbftClient
 
     name, n, clients, default_requests, byzantine = CONFIGS[index]
-    if byzantine:
-        raise ValueError("byzantine config is simulation-only (use --arm cpu/jax)")
     # The native runtime pipelines across rounds, so give it enough
     # requests to measure steady state even on the demo config.
     reqs_total = requests or max(default_requests, 100)
     per_client = max(1, reqs_total // clients)
     reqs_total = per_client * clients
-    with LocalCluster(n=n, verifier="cpu", metrics_every=1) as cluster:
+    with LocalCluster(
+        n=n,
+        verifier="cpu",
+        metrics_every=1,
+        byzantine=[n - 1] if byzantine else None,
+    ) as cluster:
         f_val = cluster.config.f
         handles = [PbftClient(cluster.config) for _ in range(clients)]
         warm = handles[0].request("warmup")
@@ -204,7 +207,7 @@ def run_native_config(
         sig_verifies_per_sec=round(sig_total / elapsed, 1),
         sig_verifications=sig_total,
         verifier="native",
-        byzantine=False,
+        byzantine=byzantine,
     )
 
 
@@ -212,8 +215,6 @@ def run_all(arm: str = "cpu", out_path: Optional[str] = None) -> List[BenchResul
     results = []
     for i in range(len(CONFIGS)):
         if arm == "native":
-            if CONFIGS[i][4]:
-                continue  # byzantine config is simulation-only
             res = run_native_config(i)
         else:
             res = run_config(i, arm=arm)

@@ -56,8 +56,13 @@ class LocalCluster:
         config: Optional[ClusterConfig] = None,
         seeds: Optional[List[bytes]] = None,
         trace_dir: Optional[str] = None,
+        byzantine: Optional[List[int]] = None,
     ):
         self.trace_dir = trace_dir
+        # Replica ids whose daemons corrupt every outgoing signature
+        # (pbftd --byzantine; native-runtime analogue of the simulation's
+        # outbound mutator). C++ daemons only.
+        self.byzantine = set(byzantine or [])
         self.discovery = discovery
         if config is None:
             config, seeds = make_local_cluster(n, base_port=0)
@@ -132,6 +137,10 @@ class LocalCluster:
                 cmd += ["--discovery", self._discovery_target]
             if self.trace_dir:
                 cmd += ["--trace", str(Path(self.trace_dir) / f"replica-{i}.jsonl")]
+            if i in self.byzantine:
+                if self.impl[i] != "cxx":
+                    raise ValueError("byzantine injection is pbftd-only")
+                cmd += ["--byzantine"]
             self._cmds.append((cmd, env))
             self.procs.append(
                 subprocess.Popen(
