@@ -7,9 +7,17 @@ Protocol (mirrors core/verifier.h RemoteVerifier):
     request:  u32be count N, then N * 128 bytes (pub 32 | msg 32 | sig 64)
     response: N bytes, each 0/1
 
-One request = one padded-batch XLA launch. Batches are padded to the next
-power of two (bounded set of compiled shapes); pad slots carry a known-good
-triple so padding cost is pure compute, never a false reject.
+Batches are padded to the next power of two (bounded set of compiled
+shapes); pad slots carry a known-good triple so padding cost is pure
+compute, never a false reject.
+
+Cross-connection coalescing: when several colocated daemons (one per
+replica on a TPU host) submit batches concurrently, a dispatcher merges
+everything queued into ONE backend call — one XLA launch for the whole
+host's quorum traffic instead of one per daemon. The launch cost is paid
+once per *window*, which is the framework's batching-window thesis applied
+at the FFI boundary. No artificial delay: the window is exactly "whatever
+queued while the previous launch ran".
 """
 
 from __future__ import annotations
@@ -44,6 +52,16 @@ def cpu_backend(items: List[Item]) -> List[bool]:
     return [ref.verify(p, m, s) for p, m, s in items]
 
 
+class _Pending:
+    __slots__ = ("items", "event", "verdicts", "error")
+
+    def __init__(self, items: List[Item]):
+        self.items = items
+        self.event = threading.Event()
+        self.verdicts: Optional[List[bool]] = None
+        self.error: Optional[Exception] = None
+
+
 class VerifierService:
     """Threaded TCP (or unix-domain) batch-verification server."""
 
@@ -53,12 +71,18 @@ class VerifierService:
         port: int = 0,
         unix_path: Optional[str] = None,
         backend: Callable[[List[Item]], List[bool]] | str = "jax",
+        coalesce: bool = True,
     ):
         if isinstance(backend, str):
             backend = {"jax": jax_backend, "cpu": cpu_backend}[backend]
         self.backend = backend
-        self.batches = 0
+        self.batches = 0  # backend calls (XLA launches)
+        self.requests = 0  # wire requests (>= batches when coalescing)
         self.items = 0
+        self._coalesce = coalesce
+        self._cond = threading.Condition()
+        self._pending: List[_Pending] = []
+        self._running = True
         service = self
 
         class Handler(socketserver.BaseRequestHandler):
@@ -77,9 +101,7 @@ class VerifierService:
                             )
                             for i in range(n)
                         ]
-                        verdicts = service.backend(items)
-                        service.batches += 1
-                        service.items += n
+                        verdicts = service._submit(items)
                         sock.sendall(bytes(1 if v else 0 for v in verdicts))
                 except (ConnectionError, OSError):
                     return
@@ -100,6 +122,85 @@ class VerifierService:
             self.server = TcpServer((host, port), Handler)
             self.address = "%s:%d" % self.server.server_address
         self._thread: Optional[threading.Thread] = None
+        self._dispatcher: Optional[threading.Thread] = None
+        if self._coalesce:
+            # Started here (not in start()) so the CLI's bare
+            # serve_forever() path coalesces too.
+            self._dispatcher = threading.Thread(
+                target=self._dispatch_loop, daemon=True
+            )
+            self._dispatcher.start()
+
+    # Largest merged window, in items: the top of the pad ladder
+    # (crypto/batch.py _PAD_LADDER) — bigger merges would compile new XLA
+    # shapes at runtime. Overflow stays queued for the next window.
+    MAX_WINDOW = 4096
+
+    def _submit(self, items: List[Item]) -> List[bool]:
+        """Handler-thread entry: verify `items`, possibly merged with other
+        connections' concurrent submissions into one backend call."""
+        if not self._coalesce:
+            with self._cond:
+                self.requests += 1
+                self.batches += 1
+                self.items += len(items)
+            return self.backend(items)
+        p = _Pending(items)
+        with self._cond:
+            self.requests += 1
+            if not self._running:  # dispatcher gone: fail this connection
+                raise ConnectionError("verifier service stopping")
+            self._pending.append(p)
+            self._cond.notify()
+        p.event.wait()
+        if p.error is not None:
+            raise ConnectionError(f"verification failed: {p.error!r}")
+        assert p.verdicts is not None
+        return p.verdicts
+
+    def _dispatch_loop(self) -> None:
+        while True:
+            with self._cond:
+                while self._running and not self._pending:
+                    self._cond.wait(0.5)
+                if not self._running and not self._pending:
+                    return
+                # Take whole requests up to MAX_WINDOW items (a single
+                # oversized request still goes through, alone).
+                window: List[_Pending] = []
+                size = 0
+                while self._pending:
+                    nxt = len(self._pending[0].items)
+                    if window and size + nxt > self.MAX_WINDOW:
+                        break
+                    size += nxt
+                    window.append(self._pending.pop(0))
+            merged: List[Item] = []
+            for p in window:
+                merged.extend(p.items)
+            try:
+                verdicts = self.backend(merged)
+            except Exception:
+                # One launch failing must not reject every client's honest
+                # signatures ("never a false reject"): retry each request
+                # alone so only the actually-poisoned one errors out.
+                verdicts = None
+            with self._cond:
+                self.batches += 1
+                self.items += len(merged)
+            if verdicts is None:
+                for p in window:
+                    try:
+                        p.verdicts = self.backend(p.items)
+                    except Exception as e:  # noqa: BLE001 - handed to submitter
+                        p.error = e
+                    p.event.set()
+                continue
+            off = 0
+            for p in window:
+                p.verdicts = verdicts[off : off + len(p.items)]
+                off += len(p.items)
+                p.event.set()
 
     def start(self) -> "VerifierService":
         self._thread = threading.Thread(
@@ -109,10 +210,18 @@ class VerifierService:
         return self
 
     def stop(self) -> None:
+        # Flip _running BEFORE joining anything: handlers enqueueing after
+        # this point get a ConnectionError instead of waiting on an event
+        # nobody will set; the dispatcher drains what's already queued.
+        with self._cond:
+            self._running = False
+            self._cond.notify_all()
         self.server.shutdown()
         self.server.server_close()
         if self._thread:
             self._thread.join(timeout=5)
+        if self._dispatcher:
+            self._dispatcher.join(timeout=5)
 
 
 def main() -> None:

@@ -1,0 +1,177 @@
+"""Cross-connection coalescing in the VerifierService: concurrent batch
+submissions from separate connections must merge into fewer backend calls
+(one XLA launch per window on TPU) with per-request verdict slices intact."""
+
+import socket
+import threading
+import time
+
+from pbft_tpu.net import VerifierService
+
+
+def _send_batch(addr: str, items):
+    host, port = addr.rsplit(":", 1)
+    payload = b"".join(p + m + s for p, m, s in items)
+    with socket.create_connection((host, int(port)), timeout=10) as sock:
+        sock.sendall(len(items).to_bytes(4, "big") + payload)
+        out = b""
+        while len(out) < len(items):
+            chunk = sock.recv(len(items) - len(out))
+            assert chunk
+            out += chunk
+    return [bool(b) for b in out]
+
+
+def _item(tag: int, valid: bool):
+    # The fake backend below deems an item valid iff sig[0] == pub[0];
+    # tag makes every item distinguishable so slicing bugs can't hide.
+    pub = bytes([tag]) * 32
+    msg = bytes([tag ^ 0xFF]) * 32
+    sig = (bytes([tag]) if valid else bytes([tag ^ 1])) + bytes(63)
+    return pub, msg, sig
+
+
+def test_concurrent_requests_coalesce_into_fewer_launches():
+    calls = []
+    gate = threading.Event()
+
+    def slow_backend(items):
+        calls.append(len(items))
+        if len(calls) == 1:
+            gate.wait(10)  # hold the first launch so others queue behind it
+        return [p[0] == s[0] for p, m, s in items]
+
+    svc = VerifierService(backend=slow_backend).start()
+    try:
+        results = {}
+
+        def client(cid: int):
+            items = [_item(cid, True), _item(cid, cid % 2 == 0)]
+            results[cid] = _send_batch(svc.address, items)
+
+        threads = [threading.Thread(target=client, args=(c,)) for c in range(1, 5)]
+        threads[0].start()
+        while not calls:  # first request is inside the backend now
+            time.sleep(0.01)
+        for t in threads[1:]:
+            t.start()
+        # Give the three remaining requests time to queue, then release.
+        deadline = time.monotonic() + 5
+        while svc.requests < 4 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        gate.set()
+        for t in threads:
+            t.join(timeout=10)
+
+        assert svc.requests == 4
+        # 1 held launch + 1 merged launch for the 3 queued requests.
+        assert svc.batches < 4, f"no coalescing happened: {calls}"
+        assert sum(calls) == 8 and svc.items == 8
+        for cid in range(1, 5):
+            assert results[cid] == [True, cid % 2 == 0], (cid, results[cid])
+    finally:
+        gate.set()
+        svc.stop()
+
+
+def test_uncoalesced_mode_still_works():
+    def backend(items):
+        return [p[0] == s[0] for p, m, s in items]
+
+    svc = VerifierService(backend=backend, coalesce=False).start()
+    try:
+        out = _send_batch(svc.address, [_item(7, True), _item(9, False)])
+        assert out == [True, False]
+        assert svc.batches == svc.requests == 1
+    finally:
+        svc.stop()
+
+
+def test_poison_batch_only_fails_its_own_connection():
+    """A backend failure on a merged launch must not false-reject other
+    clients' honest signatures: the window is retried per-request and only
+    the poisoned connection errors out."""
+    gate = threading.Event()
+    first = threading.Event()
+
+    def backend(items):
+        if not first.is_set():
+            first.set()
+            gate.wait(10)
+            # fall through: the held first request itself verifies fine
+        if any(p[0] == 66 for p, m, s in items):
+            raise RuntimeError("poison")
+        return [p[0] == s[0] for p, m, s in items]
+
+    svc = VerifierService(backend=backend).start()
+    try:
+        results = {}
+
+        def client(cid: int):
+            try:
+                results[cid] = _send_batch(svc.address, [_item(cid, True)])
+            except (AssertionError, ConnectionError, OSError):
+                results[cid] = "error"
+
+        t1 = threading.Thread(target=client, args=(1,))
+        t1.start()
+        while not first.is_set():
+            time.sleep(0.01)
+        others = [threading.Thread(target=client, args=(c,)) for c in (65, 66, 67)]
+        for t in others:
+            t.start()
+        deadline = time.monotonic() + 5
+        while svc.requests < 4 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        gate.set()
+        t1.join(timeout=10)
+        for t in others:
+            t.join(timeout=10)
+        assert results[1] == [True]
+        assert results[65] == [True]
+        assert results[66] == "error"  # the poisoned one, and only it
+        assert results[67] == [True]
+    finally:
+        gate.set()
+        svc.stop()
+
+
+def test_window_respects_pad_ladder_cap():
+    """Merged windows never exceed MAX_WINDOW items (the top of the XLA
+    pad ladder) — oversized merges would compile new shapes at runtime."""
+    calls = []
+    gate = threading.Event()
+
+    def backend(items):
+        calls.append(len(items))
+        if len(calls) == 1:
+            gate.wait(10)
+        return [p[0] == s[0] for p, m, s in items]
+
+    svc = VerifierService(backend=backend).start()
+    svc.MAX_WINDOW = 4  # instance override for the test
+    try:
+        threads = [
+            threading.Thread(
+                target=lambda c=c: _send_batch(
+                    svc.address, [_item(c, True), _item(c, True)]
+                )
+            )
+            for c in range(1, 8)
+        ]
+        threads[0].start()
+        while not calls:
+            time.sleep(0.01)
+        for t in threads[1:]:
+            t.start()
+        deadline = time.monotonic() + 5
+        while svc.requests < 7 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        gate.set()
+        for t in threads:
+            t.join(timeout=10)
+        assert all(size <= 4 for size in calls), calls
+        assert sum(calls) == 14
+    finally:
+        gate.set()
+        svc.stop()
