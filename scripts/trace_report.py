@@ -1,0 +1,92 @@
+#!/usr/bin/env python
+"""Summarize pbft_tpu JSONL traces (pbftd --trace / server.py --trace).
+
+Reads one or more per-replica trace files and prints, per replica and
+cluster-wide: verify-batch count/size/time percentiles, batching-window
+efficiency (items per launch — the number the TPU batching design exists
+to maximize), rejected-signature totals, and view-change events.
+
+Usage: python scripts/trace_report.py /path/to/trace-dir-or-files...
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import sys
+
+
+def _pct(sorted_vals, q: float):
+    if not sorted_vals:
+        return 0
+    return sorted_vals[min(len(sorted_vals) - 1, int(q * len(sorted_vals)))]
+
+
+def load(path: pathlib.Path):
+    events = []
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                events.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+    return events
+
+
+def report(files) -> dict:
+    total = {"batches": 0, "items": 0, "rejected": 0, "secs": 0.0, "vcs": 0}
+    for path in files:
+        events = load(path)
+        vb = [e for e in events if e.get("ev") == "verify_batch"]
+        vcs = [e for e in events if e.get("ev") == "view_change"]
+        sizes = sorted(e["size"] for e in vb)
+        secs = sorted(e["secs"] for e in vb)
+        rejected = sum(e.get("rejected", 0) for e in vb)
+        total["batches"] += len(vb)
+        total["items"] += sum(sizes)
+        total["rejected"] += rejected
+        total["secs"] += sum(secs)
+        total["vcs"] += len(vcs)
+        if vb:
+            span = vb[-1]["ts"] - vb[0]["ts"] or 1e-9
+            print(
+                f"{path.name}: {len(vb)} batches, {sum(sizes)} items "
+                f"(size p50={_pct(sizes, 0.5)} p90={_pct(sizes, 0.9)} "
+                f"max={sizes[-1]}), verify p50={_pct(secs, 0.5) * 1e3:.2f}ms "
+                f"p90={_pct(secs, 0.9) * 1e3:.2f}ms, "
+                f"{sum(sizes) / span:.0f} items/s, rejected={rejected}, "
+                f"view_changes={len(vcs)}"
+            )
+        else:
+            print(f"{path.name}: no verify_batch events")
+    if total["batches"]:
+        print(
+            f"cluster: {total['items']} verifications in {total['batches']} "
+            f"launches = {total['items'] / total['batches']:.1f} items/launch "
+            f"(batching-window efficiency), {total['rejected']} rejected, "
+            f"{total['vcs']} view changes, "
+            f"{total['secs']:.2f}s total verify time"
+        )
+    return total
+
+
+def main() -> None:
+    if len(sys.argv) < 2:
+        sys.exit(__doc__)
+    files = []
+    for arg in sys.argv[1:]:
+        p = pathlib.Path(arg)
+        if p.is_dir():
+            files.extend(sorted(p.glob("*.jsonl")))
+        else:
+            files.append(p)
+    if not files:
+        sys.exit("no trace files found")
+    report(files)
+
+
+if __name__ == "__main__":
+    main()
