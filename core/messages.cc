@@ -54,6 +54,7 @@ Json ClientReply::to_json() const {
   o.emplace("client", client);
   o.emplace("replica", replica);
   o.emplace("result", result);
+  o.emplace("sig", sig);
   o.emplace("timestamp", timestamp);
   o.emplace("type", "client-reply");
   o.emplace("view", view);
@@ -201,7 +202,7 @@ std::optional<Message> message_from_json(const Json& j) {
     ClientReply r;
     if (!get_int(j, "view", &r.view) || !get_int(j, "timestamp", &r.timestamp) ||
         !get_str(j, "client", &r.client) || !get_int(j, "replica", &r.replica) ||
-        !get_str(j, "result", &r.result))
+        !get_str(j, "result", &r.result) || !get_str(j, "sig", &r.sig))
       return std::nullopt;
     return Message(std::move(r));
   }

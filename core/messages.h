@@ -47,6 +47,7 @@ struct ClientReply {
   std::string client;
   int64_t replica = 0;
   std::string result;
+  std::string sig;  // hex; §4.1 reply votes must prove their caster
 
   Json to_json() const;
 };

@@ -341,6 +341,7 @@ Actions Replica::drain_executions() {
         reply.client = req.client;
         reply.replica = id_;
         reply.result = result;
+        reply = sign(reply);  // §4.1: a reply vote must prove its caster
         last_reply_[req.client] = reply;
         out.replies.push_back({req.client, reply});
       }
@@ -376,6 +377,7 @@ std::string Replica::checkpoint_payload(int64_t seq) const {
   for (const auto& [client, reply] : last_reply_) {  // std::map: sorted
     Json rj = reply.to_json();
     rj.as_object()["replica"] = Json((int64_t)-1);
+    rj.as_object()["sig"] = Json(std::string());  // replica-local too
     replies.push_back(Json(JsonArray{Json(client), std::move(rj)}));
   }
   o.emplace("replies", Json(std::move(replies)));
@@ -429,6 +431,7 @@ Actions Replica::on_state_response(const StateResponse& resp) {
     if (!reply) return {};
     ClientReply r = *reply;
     r.replica = id_;
+    r = sign(r);  // a resent cached reply carries THIS replica's vote
     new_replies.emplace(client.as_string(), std::move(r));
   }
   std::map<std::string, int64_t> new_timestamps;

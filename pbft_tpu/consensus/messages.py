@@ -121,7 +121,11 @@ class ClientRequest(Message):
 
 @dataclasses.dataclass(frozen=True)
 class ClientReply(Message):
-    """Reply dialed back to the client (reference src/message.rs:55-72)."""
+    """Reply dialed back to the client (reference src/message.rs:55-72),
+    signed by the replying replica: PBFT §4.1's f+1 reply quorum only
+    means something if a vote proves which replica cast it — unsigned
+    replies let one faulty party mint arbitrary votes on the dial-back
+    channel."""
 
     TYPE: ClassVar[str] = "client-reply"
     view: int
@@ -129,6 +133,7 @@ class ClientReply(Message):
     client: str
     replica: int
     result: str
+    sig: str = ""
 
 
 @dataclasses.dataclass(frozen=True)

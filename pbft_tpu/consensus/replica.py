@@ -434,12 +434,14 @@ class Replica:
                         digest_size=32,
                     ).digest()
                     self.last_timestamp[req.client] = req.timestamp
-                    reply = ClientReply(
-                        view=view,
-                        timestamp=req.timestamp,
-                        client=req.client,
-                        replica=self.id,
-                        result=result,
+                    reply = self._sign(
+                        ClientReply(
+                            view=view,
+                            timestamp=req.timestamp,
+                            client=req.client,
+                            replica=self.id,
+                            result=result,
+                        )
                     )
                     self.last_reply[req.client] = reply
                     out.append(Reply(req.client, reply))
@@ -472,11 +474,12 @@ class Replica:
         obj = {
             "app": self._app_snapshot(),
             "chain": self.state_digest.hex(),
-            # The reply cache is replica-local only in its `replica` field;
-            # normalize it to -1 so all correct replicas digest identical
-            # payload bytes (the restorer stamps its own id back in).
+            # The reply cache is replica-local in its `replica` and `sig`
+            # fields; normalize both so all correct replicas digest
+            # identical payload bytes (the restorer stamps its own id back
+            # in and re-signs).
             "replies": [
-                [c, {**self.last_reply[c].to_dict(), "replica": -1}]
+                [c, {**self.last_reply[c].to_dict(), "replica": -1, "sig": ""}]
                 for c in sorted(self.last_reply)
             ],
             "seq": seq,
@@ -514,14 +517,16 @@ class Replica:
             import json as _json
 
             obj = _json.loads(resp.snapshot)
-            replies = {
-                c: dataclasses.replace(
-                    Message.from_dict(dict(d)), replica=self.id
+            replies = {}
+            for c, d in obj["replies"]:
+                m = Message.from_dict(dict(d))
+                if not isinstance(m, ClientReply):
+                    return []
+                # Stamp our id back in and re-sign: a resent cached reply
+                # must carry THIS replica's vote, not a blank one.
+                replies[c] = self._sign(
+                    dataclasses.replace(m, replica=self.id)
                 )
-                for c, d in obj["replies"]
-            }
-            if not all(isinstance(r, ClientReply) for r in replies.values()):
-                return []
             timestamps = {c: int(t) for c, t in obj["timestamps"]}
             chain = bytes.fromhex(obj["chain"])
         except (KeyError, TypeError, ValueError):

@@ -17,7 +17,27 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from ..consensus.config import ClusterConfig
-from ..consensus.messages import ClientRequest
+from ..consensus.messages import ClientReply, ClientRequest
+
+
+def _host_verify(pub: bytes, msg: bytes, sig: bytes) -> bool:
+    """Native C++ verifier when built, else the Python oracle."""
+    global _VERIFIER
+    if _VERIFIER is None:
+        from ..crypto import ref
+
+        _VERIFIER = ref.verify
+        try:
+            from .. import native
+
+            if native.available():
+                _VERIFIER = native.verify
+        except Exception:  # pragma: no cover - unbuilt native core
+            pass
+    return _VERIFIER(pub, msg, sig)
+
+
+_VERIFIER = None
 
 
 class PbftClient:
@@ -119,6 +139,26 @@ class PbftClient:
                 for rid in range(self.config.n):
                     send_to(rid)
 
+    def _reply_signature_valid(self, r: dict, rid: int) -> bool:
+        """Check the reply's Ed25519 signature against the configured
+        pubkey of the replica it claims to come from."""
+        try:
+            reply = ClientReply(
+                view=int(r["view"]),
+                timestamp=int(r["timestamp"]),
+                client=str(r["client"]),
+                replica=rid,
+                result=str(r["result"]),
+                sig=str(r["sig"]),
+            )
+            sig = bytes.fromhex(reply.sig)
+            pub = bytes.fromhex(self.config.identity(rid).pubkey)
+            if len(sig) != 64 or len(pub) != 32:
+                return False
+            return _host_verify(pub, reply.signable(), sig)
+        except (KeyError, TypeError, ValueError):
+            return False
+
     def wait_result(
         self, timestamp: int, f: Optional[int] = None, timeout: float = 10.0
     ) -> str:
@@ -133,14 +173,16 @@ class PbftClient:
                 votes: Dict[int, Tuple[str, int]] = {}
                 for r in self.replies:
                     rid = r.get("replica")
-                    # Membership bound: the reply channel is unauthenticated,
-                    # so ids outside the configured cluster must not mint
-                    # extra votes (full §4.1 needs reply signatures; the
-                    # bound at least caps a forger to its own one vote).
                     if not isinstance(rid, int) or not 0 <= rid < self.config.n:
                         continue
-                    if r.get("timestamp") == timestamp:
-                        votes[rid] = (r.get("result"), r.get("view"))
+                    if r.get("timestamp") != timestamp:
+                        continue
+                    # §4.1 for real: a reply only votes if it carries a
+                    # valid signature from the replica it claims to be —
+                    # the dial-back channel is otherwise forgeable.
+                    if not self._reply_signature_valid(r, rid):
+                        continue
+                    votes[rid] = (r.get("result"), r.get("view"))
                 by_result: Dict[Tuple[str, int], int] = {}
                 for key in votes.values():
                     by_result[key] = by_result.get(key, 0) + 1

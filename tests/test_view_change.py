@@ -206,28 +206,63 @@ def test_stable_digest_ignores_byzantine_first_checkpoint():
     assert replicas[2]._stable_digest_for([vc], 10) == good
 
 
+def _signed_reply_dict(seeds, rid, ts, result="awesome!", view=0, client="c:1"):
+    from pbft_tpu.consensus.messages import ClientReply
+    from pbft_tpu.crypto import ref
+
+    rep = ClientReply(
+        view=view, timestamp=ts, client=client, replica=rid, result=result
+    )
+    return {**rep.to_dict(), "sig": ref.sign(seeds[rid], rep.signable()).hex()}
+
+
 def test_client_reply_quorum_one_vote_per_replica():
     """f+1 reply quorum must count distinct replicas: duplicate replies from
-    one replica (retransmissions on the unauthenticated reply channel) do
-    not satisfy it (PBFT §4.1)."""
+    one replica (retransmissions) do not satisfy it (PBFT §4.1)."""
     import pytest
 
     from pbft_tpu.net.client import PbftClient
 
-    config, _seeds = make_local_cluster(4)
+    config, seeds = make_local_cluster(4)
     client = PbftClient.__new__(PbftClient)
     client.config = config
     import threading
 
     client._new_reply = threading.Condition()
     # Three copies of replica 2's reply: one vote, no quorum.
-    client.replies = [
-        {"timestamp": 7, "result": "awesome!", "view": 0, "replica": 2}
-    ] * 3
+    client.replies = [_signed_reply_dict(seeds, 2, 7)] * 3
     with pytest.raises(TimeoutError):
         client.wait_result(7, timeout=0.2)
     # A second distinct replica completes the f+1 = 2 quorum.
-    client.replies.append(
-        {"timestamp": 7, "result": "awesome!", "view": 0, "replica": 3}
-    )
+    client.replies.append(_signed_reply_dict(seeds, 3, 7))
     assert client.wait_result(7, timeout=0.2) == "awesome!"
+
+
+def test_client_reply_quorum_rejects_forged_signatures():
+    """The dial-back channel is forgeable; votes only count with a valid
+    signature from the claimed replica. A forger who controls one replica
+    (or none) cannot mint the f+1 quorum (PBFT §4.1, done for real —
+    the reference had no signatures anywhere, src/behavior.rs:127)."""
+    import pytest
+
+    from pbft_tpu.net.client import PbftClient
+
+    config, seeds = make_local_cluster(4)
+    client = PbftClient.__new__(PbftClient)
+    client.config = config
+    import threading
+
+    client._new_reply = threading.Condition()
+    good = _signed_reply_dict(seeds, 2, 9)
+    # Forgeries: replica 3's vote signed with replica 2's key; an unsigned
+    # vote; a garbage signature. None may complete the quorum.
+    wrong_key = dict(_signed_reply_dict(seeds, 2, 9))
+    wrong_key["replica"] = 3
+    unsigned = {**_signed_reply_dict(seeds, 3, 9), "sig": ""}
+    garbage = {**_signed_reply_dict(seeds, 3, 9), "sig": "ab" * 64}
+    client.replies = [good, wrong_key, unsigned, garbage]
+    with pytest.raises(TimeoutError):
+        client.wait_result(9, timeout=0.2)
+    # The genuine second vote still works.
+    client.replies.append(_signed_reply_dict(seeds, 3, 9))
+    assert client.wait_result(9, timeout=0.2) == "awesome!"
