@@ -17,14 +17,25 @@ the tunnel, not the TPU; transfer time is logged to stderr separately.
 
 Robustness (the same script must survive a moody tunnel): persistent
 compile cache, a watchdog around backend init that fails fast with a
-diagnostic JSON line instead of hanging, one init retry, and a result line
-even if only a single timed chain completes. Before touching the backend
-in-process, the TPU is probed in DISPOSABLE SUBPROCESSES (a wedged tunnel
-hangs the whole process uninterruptibly — observed live in round 3); if
-the probes never succeed, the bench falls back to the framework's CPU
-verifier arm (native C++ Ed25519 when built, else XLA:CPU at a small
-batch) and reports a real measured number tagged "backend":
-"cpu-native-fallback" / "cpu-fallback" instead of a useless 0.0 artifact.
+diagnostic JSON line instead of hanging, and a result line even if only a
+single timed chain completes. The round-3 lesson (BENCH_r03.json captured
+a CPU fallback because two 75 s probes hit a multi-hour tunnel wedge): the
+tunnel can wedge at ANY point, including mid-bench, and a wedged PJRT call
+hangs the process uninterruptibly. So the orchestrator in this process
+never touches the backend at all:
+
+  1. PROBE: `jax.devices()` in disposable subprocesses — default 8
+     attempts x 60 s with backoff gaps between them (~13 min worst
+     case, well inside the driver budget).
+  2. RUN: the whole TPU bench (backend init, compile, timed region) runs
+     in a KILLABLE WORKER SUBPROCESS (`bench.py --tpu-worker`) under a
+     timeout; a mid-bench wedge kills the worker and the orchestrator
+     re-probes and retries instead of dying.
+  3. FALLBACK: only after the full probe+retry budget is spent does it
+     fall back to the framework's CPU verifier arm (native C++ Ed25519
+     when built, else XLA:CPU at a small batch) and report a real
+     measured number tagged "backend": "cpu-native-fallback" /
+     "cpu-fallback" instead of a useless 0.0 artifact.
 
 Baseline for vs_baseline: the reference publishes no numbers and does not
 compile (SURVEY.md §6); BASELINE.json's target is >= 50,000 verifies/sec on
@@ -112,6 +123,7 @@ def _probe_tpu(timeout_s: float, attempts: int, gap_s: float) -> bool:
     import subprocess
 
     code = "import jax; d = jax.devices(); print(len(d), d[0].platform)"
+    gap = gap_s
     for attempt in range(1, attempts + 1):
         t0 = time.perf_counter()
         try:
@@ -141,7 +153,8 @@ def _probe_tpu(timeout_s: float, attempts: int, gap_s: float) -> bool:
             tail = (out.stderr or "").strip().splitlines()[-1:] or ["no stderr"]
             _log(f"tpu probe {attempt}/{attempts}: rc={out.returncode} {tail[0]}")
         if attempt < attempts:
-            time.sleep(gap_s)
+            time.sleep(gap)
+            gap = min(gap * 2.0, 60.0)
     return False
 
 
@@ -254,34 +267,114 @@ def _native_fallback(target_secs: float, reason: str) -> bool:
     return True
 
 
+def _run_worker(timeout_s: float) -> dict | None:
+    """Run the full TPU bench in a killable subprocess.
+
+    Returns the worker's JSON result dict, or None when the worker wedged
+    (killed at timeout) or produced no parseable result line. The worker's
+    stderr is inherited so its progress lands in this process's stderr.
+    """
+    import subprocess
+
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--tpu-worker"],
+        stdout=subprocess.PIPE,
+        text=True,
+    )
+    try:
+        out, _ = proc.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        try:
+            out, _ = proc.communicate(timeout=15)
+        except subprocess.TimeoutExpired:  # pragma: no cover - unkillable child
+            out = ""
+        _log(f"tpu worker: killed after {timeout_s:.0f}s")
+        # A worker that printed its result and THEN wedged in teardown
+        # (interpreter-exit PJRT cleanup over a dead tunnel) still counts:
+        # don't throw away a completed measurement.
+        result = _parse_result(out)
+        if result is not None:
+            _log("tpu worker: result line recovered from killed worker")
+        return result
+    result = _parse_result(out)
+    if result is None:
+        _log(f"tpu worker: rc={proc.returncode}, no JSON result line")
+    return result
+
+
+def _parse_result(out: str | None) -> dict | None:
+    for line in reversed((out or "").strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except ValueError:
+                continue
+    return None
+
+
 def main() -> None:
-    backend = "tpu"
-    fallback_reason = None
     target_secs = float(os.environ.get("PBFT_BENCH_SECS", "5.0"))
+    if "--tpu-worker" in sys.argv:
+        _run_xla_bench("tpu", None, target_secs)
+        return
     if os.environ.get("PBFT_BENCH_CPU") or os.environ.get("JAX_PLATFORMS") == "cpu":
         os.environ["JAX_PLATFORMS"] = "cpu"
-        backend = "cpu"
         _force_cpu()
-    elif not _probe_tpu(
-        timeout_s=float(os.environ.get("PBFT_BENCH_PROBE_TIMEOUT", "75")),
-        attempts=int(os.environ.get("PBFT_BENCH_PROBES", "2")),
-        gap_s=float(os.environ.get("PBFT_BENCH_PROBE_GAP", "30")),
-    ):
-        fallback_reason = "tpu backend init never succeeded; CPU fallback"
-        _log(fallback_reason)
-        if _native_fallback(target_secs, fallback_reason):
-            return
-        # Last resort: TPU unreachable AND native core unbuilt — measure
-        # the XLA:CPU backend at a small batch rather than emit 0.0. The
-        # conv field-mul compiles ~10x faster on XLA:CPU, and batch 64
-        # keeps compile ~1 minute (measured).
-        backend = "cpu-fallback"
-        os.environ["JAX_PLATFORMS"] = "cpu"
-        os.environ.setdefault("PBFT_FIELD_MUL", "conv")
-        os.environ.setdefault("PBFT_BENCH_BATCH", "64")
-        os.environ.setdefault("PBFT_BENCH_CHAIN", "4")
-        _force_cpu()
+        _run_xla_bench("cpu", None, target_secs)
+        return
+
+    # TPU path: probe in disposable subprocesses, then run the bench in a
+    # killable worker; retry (with a short re-probe) if the worker wedges.
+    probed = _probe_tpu(
+        timeout_s=float(os.environ.get("PBFT_BENCH_PROBE_TIMEOUT", "60")),
+        attempts=int(os.environ.get("PBFT_BENCH_PROBES", "8")),
+        gap_s=float(os.environ.get("PBFT_BENCH_PROBE_GAP", "10")),
+    )
+    if probed:
+        worker_timeout = float(os.environ.get("PBFT_BENCH_WORKER_TIMEOUT", "600"))
+        tpu_attempts = int(os.environ.get("PBFT_BENCH_TPU_ATTEMPTS", "3"))
+        for attempt in range(1, tpu_attempts + 1):
+            result = _run_worker(worker_timeout)
+            if result and not result.get("error") and result.get("value", 0) > 0:
+                print(json.dumps(result))
+                return
+            _log(f"tpu worker attempt {attempt}/{tpu_attempts} failed: {result}")
+            # Only transient failures (wedge-kill -> None, or backend init
+            # trouble) are worth a retry; a deterministic in-bench error
+            # (wrong verdicts, kernel exception) will just fail identically
+            # two more expensive times.
+            err = (result or {}).get("error", "")
+            if result is not None and not err.startswith("backend-init"):
+                break
+            if attempt < tpu_attempts and not _probe_tpu(
+                timeout_s=60.0, attempts=3, gap_s=15.0
+            ):
+                break
+    fallback_reason = "tpu bench never completed; CPU fallback"
+    _log(fallback_reason)
+    if _native_fallback(target_secs, fallback_reason):
+        return
+    # Last resort: TPU unreachable AND native core unbuilt — measure
+    # the XLA:CPU backend at a small batch rather than emit 0.0. The
+    # conv field-mul compiles ~10x faster on XLA:CPU, and batch 64
+    # keeps compile ~1 minute (measured).
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.setdefault("PBFT_FIELD_MUL", "conv")
+    os.environ.setdefault("PBFT_BENCH_BATCH", "64")
+    os.environ.setdefault("PBFT_BENCH_CHAIN", "4")
+    _force_cpu()
+    _run_xla_bench("cpu-fallback", fallback_reason, target_secs)
+
+
+def _run_xla_bench(backend: str, fallback_reason: str | None, target_secs: float) -> None:
     devices = _init_backend(float(os.environ.get("PBFT_BENCH_INIT_TIMEOUT", "180")))
+    if backend == "tpu" and (not devices or devices[0].platform == "cpu"):
+        # jax.devices() silently falls back to XLA:CPU when the plugin
+        # fails AFTER the probe passed; a CPU number must never be
+        # reported under the "tpu" tag.
+        _fail("backend-init", f"tpu worker got non-TPU devices: {devices}")
 
     import jax
     import jax.numpy as jnp
