@@ -66,13 +66,30 @@ void compress(uint64_t h[8], const uint8_t block[128], uint64_t t, bool last) {
 
 }  // namespace
 
-void blake2b(uint8_t* out, size_t outlen, const uint8_t* in, size_t inlen) {
+void blake2b_keyed(uint8_t* out, size_t outlen, const uint8_t* key,
+                   size_t keylen, const uint8_t* in, size_t inlen) {
   uint64_t h[8];
   for (int i = 0; i < 8; ++i) h[i] = kIV[i];
-  h[0] ^= 0x01010000ULL ^ static_cast<uint64_t>(outlen);
+  h[0] ^= 0x01010000ULL ^ (static_cast<uint64_t>(keylen) << 8) ^
+          static_cast<uint64_t>(outlen);
 
   uint8_t block[128];
   uint64_t t = 0;
+  if (keylen) {
+    // RFC 7693 §2.9: the key is padded to one full block and compressed
+    // first; it is the final block only when the message is empty.
+    std::memset(block, 0, sizeof(block));
+    std::memcpy(block, key, keylen);
+    t = 128;
+    if (inlen == 0) {
+      compress(h, block, t, true);
+      uint8_t full0[64];
+      std::memcpy(full0, h, sizeof(full0));
+      std::memcpy(out, full0, outlen);
+      return;
+    }
+    compress(h, block, t, false);
+  }
   // Full blocks except the last (the final block is always processed with
   // the finalization flag, even when the input is block-aligned).
   while (inlen > 128) {
@@ -90,6 +107,10 @@ void blake2b(uint8_t* out, size_t outlen, const uint8_t* in, size_t inlen) {
   uint8_t full[64];
   std::memcpy(full, h, sizeof(full));
   std::memcpy(out, full, outlen);
+}
+
+void blake2b(uint8_t* out, size_t outlen, const uint8_t* in, size_t inlen) {
+  blake2b_keyed(out, outlen, nullptr, 0, in, inlen);
 }
 
 }  // namespace pbft

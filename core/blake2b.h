@@ -12,6 +12,12 @@ namespace pbft {
 // Unkeyed Blake2b with digest length 1..64 bytes.
 void blake2b(uint8_t* out, size_t outlen, const uint8_t* in, size_t inlen);
 
+// Keyed Blake2b (RFC 7693 §2.9 MAC/PRF mode, key length 0..64 bytes) —
+// the secure-link KDF and AEAD primitive (core/secure.cc), byte-identical
+// to Python hashlib.blake2b(key=...).
+void blake2b_keyed(uint8_t* out, size_t outlen, const uint8_t* key,
+                   size_t keylen, const uint8_t* in, size_t inlen);
+
 inline void blake2b_256(uint8_t out[32], const uint8_t* in, size_t inlen) {
   blake2b(out, 32, in, inlen);
 }

@@ -9,6 +9,7 @@
 #include "blake2b.h"
 #include "ed25519.h"
 #include "messages.h"
+#include "secure.h"
 #include "sha512.h"
 
 extern "C" {
@@ -65,6 +66,39 @@ void pbft_ed25519_verify_batch(const uint8_t* pubs, const uint8_t* msgs,
                  ? 1
                  : 0;
   }
+}
+
+// --- Secure-link primitives (interop pinning vs pbft_tpu/net/secure.py).
+
+void pbft_blake2b_keyed(uint8_t* out, size_t outlen, const uint8_t* key,
+                        size_t keylen, const uint8_t* in, size_t inlen) {
+  pbft::blake2b_keyed(out, outlen, key, keylen, in, inlen);
+}
+
+void pbft_dh_public(uint8_t pub[32], const uint8_t secret[32]) {
+  pbft::ed25519_dh_public(pub, secret);
+}
+
+int pbft_dh_shared(uint8_t out[32], const uint8_t secret[32],
+                   const uint8_t peer_pub[32]) {
+  return pbft::ed25519_dh_shared(out, secret, peer_pub) ? 1 : 0;
+}
+
+// sealed (= ct || 16B tag) written to out (cap in+16 bytes required).
+void pbft_aead_seal(const uint8_t key[64], uint64_t ctr, const uint8_t* in,
+                    size_t inlen, uint8_t* out) {
+  std::string sealed =
+      pbft::aead_seal(key, ctr, std::string((const char*)in, inlen));
+  std::memcpy(out, sealed.data(), sealed.size());
+}
+
+// Returns plaintext length, or -1 on tag mismatch (out cap = inlen).
+long pbft_aead_open(const uint8_t key[64], uint64_t ctr, const uint8_t* in,
+                    size_t inlen, uint8_t* out) {
+  auto pt = pbft::aead_open(key, ctr, std::string((const char*)in, inlen));
+  if (!pt) return -1;
+  std::memcpy(out, pt->data(), pt->size());
+  return (long)pt->size();
 }
 
 }  // extern "C"

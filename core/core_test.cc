@@ -17,6 +17,7 @@
 #include "json.h"
 #include "messages.h"
 #include "replica.h"
+#include "secure.h"
 #include "sha512.h"
 #include "verifier.h"
 
@@ -355,6 +356,57 @@ void test_state_transfer_native() {
   CHECK(matching == 4);
 }
 
+void test_secure_channel_native() {
+  // Two-replica config with real identity keys.
+  pbft::ClusterConfig cfg;
+  uint8_t seeds[2][32];
+  for (int i = 0; i < 2; ++i) {
+    std::memset(seeds[i], i + 1, 32);
+    pbft::ReplicaIdentity id;
+    id.replica_id = i;
+    id.host = "127.0.0.1";
+    id.port = 0;
+    pbft::ed25519_public_key(id.pubkey, seeds[i]);
+    cfg.replicas.push_back(id);
+  }
+  cfg.secure = true;
+  pbft::SecureChannel a(&cfg, 0, seeds[0], /*initiator=*/true, 1);
+  pbft::SecureChannel b(&cfg, 1, seeds[1], /*initiator=*/false);
+  auto h1 = pbft::Json::parse(a.initiator_hello());
+  CHECK(h1.has_value());
+  auto reply = b.on_hello(*h1);
+  CHECK(reply.has_value());
+  auto h2 = pbft::Json::parse(*reply);
+  auto auth = a.on_hello_reply(*h2);
+  CHECK(auth.has_value());
+  auto ja = pbft::Json::parse(*auth);
+  CHECK(b.on_auth(*ja));
+  CHECK(a.established() && b.established());
+  CHECK(a.peer_id() == 1 && b.peer_id() == 0);
+  // Sealed frames round-trip; tampering and replay are rejected.
+  std::string payload = "{\"type\":\"prepare\",\"view\":0}";
+  std::string sealed = a.seal_frame(payload);
+  auto opened = b.open_frame(sealed);
+  CHECK(opened.has_value() && *opened == payload);
+  CHECK(!b.open_frame(sealed).has_value());  // replay: counter advanced
+  std::string sealed2 = a.seal_frame(payload);
+  sealed2[2] ^= 0x10;
+  CHECK(!b.open_frame(sealed2).has_value());
+  // Version mismatch rejected with a clear error.
+  pbft::SecureChannel c(&cfg, 1, seeds[1], /*initiator=*/false);
+  auto bad = pbft::Json::parse(
+      "{\"type\":\"hello\",\"ver\":\"pbft-tpu/9.9.9\",\"node\":0,\"eph\":\"" +
+      std::string(64, '0') + "\"}");
+  CHECK(bad.has_value());
+  CHECK(!c.on_hello(*bad).has_value());
+  CHECK(c.error().find("version mismatch") != std::string::npos);
+  // Plaintext hello into a secure responder rejected.
+  pbft::SecureChannel d(&cfg, 1, seeds[1], /*initiator=*/false);
+  auto plain = pbft::Json::parse(pbft::SecureChannel::plain_hello(0));
+  CHECK(!d.on_hello(*plain).has_value());
+  CHECK(d.error().find("plaintext peer rejected") != std::string::npos);
+}
+
 }  // namespace
 
 int main() {
@@ -362,6 +414,7 @@ int main() {
   test_blake2b_vector();
   test_ed25519_rfc8032();
   test_canonical_json();
+  test_secure_channel_native();
   test_four_replica_commit();
   test_view_change_native();
   test_stable_digest_majority_native();

@@ -520,6 +520,46 @@ void ed25519_sign(uint8_t sig[64], const uint8_t seed[32], const uint8_t* msg,
   sc_to_bytes(sig + 32, s);
 }
 
+// --- Ephemeral Diffie-Hellman on edwards25519 (core/secure.cc handshake).
+// X25519-style clamping clears the cofactor (the scalar is a multiple of
+// 8), so a small-order peer point collapses to the identity and is
+// rejected instead of zeroing the key contribution.
+
+namespace {
+void dh_clamp(uint8_t clamped[32], const uint8_t secret[32]) {
+  std::memcpy(clamped, secret, 32);
+  clamped[0] &= 248;
+  clamped[31] &= 127;
+  clamped[31] |= 64;
+}
+constexpr uint8_t kIdentityEnc[32] = {1};  // compressed identity: y = 1
+}  // namespace
+
+void ed25519_dh_public(uint8_t pub[32], const uint8_t secret[32]) {
+  uint8_t clamped[32];
+  dh_clamp(clamped, secret);
+  u64 k[4];
+  sc_from_bytes(k, clamped);
+  ge_compress(pub, scalar_mult_base(k));
+}
+
+bool ed25519_dh_shared(uint8_t out[32], const uint8_t secret[32],
+                       const uint8_t peer_pub[32]) {
+  ge p;
+  if (!ge_decompress(&p, peer_pub)) return false;
+  uint8_t clamped[32];
+  dh_clamp(clamped, secret);
+  // Plain double-and-add (handshakes are once per connection; no need for
+  // the comb/Shamir machinery here).
+  ge acc = kGeIdentity;
+  for (int i = 255; i >= 0; --i) {
+    acc = ge_dbl(acc);
+    if ((clamped[i >> 3] >> (i & 7)) & 1) acc = ge_add(acc, p);
+  }
+  ge_compress(out, acc);
+  return std::memcmp(out, kIdentityEnc, 32) != 0;
+}
+
 bool ed25519_verify(const uint8_t pub[32], const uint8_t* msg, size_t msglen,
                     const uint8_t sig[64]) {
   ge a;

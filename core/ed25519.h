@@ -28,4 +28,13 @@ void ed25519_sign(uint8_t sig[64], const uint8_t seed[32], const uint8_t* msg,
 bool ed25519_verify(const uint8_t pub[32], const uint8_t* msg, size_t msglen,
                     const uint8_t sig[64]);
 
+// Ephemeral DH on edwards25519 for the secure-link handshake
+// (core/secure.cc; mirror of pbft_tpu/net/secure.py dh_keypair/dh_shared).
+// Public key from a 32-byte secret (clamped X25519-style).
+void ed25519_dh_public(uint8_t pub[32], const uint8_t secret[32]);
+// Shared secret = compress(clamp(secret) * peer point); false on an
+// invalid peer encoding or a small-order (identity) result.
+bool ed25519_dh_shared(uint8_t out[32], const uint8_t secret[32],
+                       const uint8_t peer_pub[32]);
+
 }  // namespace pbft

@@ -59,6 +59,7 @@ ReplicaServer::ReplicaServer(ClusterConfig cfg, int64_t id,
                              const uint8_t seed[32],
                              std::unique_ptr<Verifier> verifier)
     : cfg_(cfg), id_(id), verifier_(std::move(verifier)) {
+  std::memcpy(seed_, seed, 32);
   replica_ = std::make_unique<Replica>(cfg_, id_, seed);
 }
 
@@ -116,10 +117,12 @@ void ReplicaServer::poll_once(int timeout_ms) {
     order.push_back(c.get());
   }
   for (auto& [_, c] : peers_) {
-    if (!c->wbuf.empty()) {
-      pfds.push_back({c->fd, POLLOUT, 0});
-      order.push_back(c.get());
-    }
+    // Outbound links are read-polled too: handshake replies and reject
+    // frames arrive on the dialed connection.
+    short ev = POLLIN;
+    if (!c->wbuf.empty()) ev |= POLLOUT;
+    pfds.push_back({c->fd, ev, 0});
+    order.push_back(c.get());
   }
   int n = ::poll(pfds.data(), pfds.size(), timeout_ms);
   if (n < 0) return;
@@ -246,12 +249,118 @@ void ReplicaServer::process_buffer(Conn& c) {
     if (c.rbuf.size() < 4 + (size_t)len) return;
     std::string payload = c.rbuf.substr(4, len);
     c.rbuf.erase(0, 4 + (size_t)len);
-    auto msg = from_payload(payload);
-    if (msg) {
-      ++frames_in_;
-      emit(replica_->receive(*msg));
-    }
+    if (!handle_peer_frame(c, std::move(payload))) return;
   }
+}
+
+namespace {
+std::string frame_payload(const std::string& payload) {
+  uint32_t n = (uint32_t)payload.size();
+  std::string out;
+  out.reserve(4 + payload.size());
+  out.push_back((char)(n >> 24));
+  out.push_back((char)(n >> 16));
+  out.push_back((char)(n >> 8));
+  out.push_back((char)n);
+  out += payload;
+  return out;
+}
+}  // namespace
+
+bool ReplicaServer::reject_conn(Conn& c, const std::string& reason) {
+  std::fprintf(stderr, "replica %lld: rejecting peer link: %s\n",
+               (long long)id_, reason.c_str());
+  c.wbuf += frame_payload(SecureChannel::reject_payload(reason));
+  flush(c);  // best-effort: the reject may be truncated if the link stalls
+  if (!c.closed) {
+    close(c.fd);
+    c.closed = true;
+  }
+  return false;
+}
+
+bool ReplicaServer::fail_conn(Conn& c, const std::string& reason) {
+  std::fprintf(stderr, "replica %lld: dropping peer link: %s\n",
+               (long long)id_, reason.c_str());
+  if (!c.closed) {
+    close(c.fd);
+    c.closed = true;
+  }
+  return false;
+}
+
+bool ReplicaServer::handle_peer_frame(Conn& c, std::string payload) {
+  if (c.peer_dest >= 0) {
+    // Dialed (initiator) link: only handshake replies and rejects arrive.
+    if (c.chan && !c.chan->established()) {
+      auto j = Json::parse(payload);
+      if (!j) return fail_conn(c, "malformed handshake reply");
+      auto auth = c.chan->on_hello_reply(*j);
+      if (!auth) return fail_conn(c, c.chan->error());
+      c.wbuf += frame_payload(*auth);
+      for (auto& p : c.pending)
+        c.wbuf += frame_payload(c.chan->seal_frame(p));
+      c.pending.clear();
+      flush(c);
+      return !c.closed;
+    }
+    if (!c.chan) {  // plaintext link: honor a version reject, ignore rest
+      auto j = Json::parse(payload);
+      const Json* t = j ? j->find("type") : nullptr;
+      if (t && t->is_string() && t->as_string() == "reject") {
+        const Json* r = j->find("reason");
+        return fail_conn(c, "peer rejected link: " +
+                                (r && r->is_string() ? r->as_string()
+                                                     : "<no reason>"));
+      }
+      return true;
+    }
+    auto pt = c.chan->open_frame(payload);
+    if (!pt) return fail_conn(c, c.chan->error());
+    payload = std::move(*pt);
+  } else if (!c.hello_seen) {
+    // Accepted link: the first frame carries the protocol version.
+    auto j = Json::parse(payload);
+    const Json* t = j ? j->find("type") : nullptr;
+    bool is_hello = t && t->is_string() && t->as_string() == "hello";
+    if (is_hello) {
+      std::string err;
+      if (!SecureChannel::check_version(*j, &err)) return reject_conn(c, err);
+      c.hello_seen = true;
+      if (cfg_.secure) {
+        c.chan = std::make_unique<SecureChannel>(&cfg_, id_, seed_,
+                                                 /*initiator=*/false);
+        auto reply = c.chan->on_hello(*j);
+        if (!reply) return reject_conn(c, c.chan->error());
+        c.wbuf += frame_payload(*reply);
+        flush(c);
+      }
+      return !c.closed;
+    }
+    if (cfg_.secure) {
+      return reject_conn(
+          c, "plaintext peer rejected: first frame must be an "
+             "encrypted-link hello");
+    }
+    c.hello_seen = true;  // tooling compat: framed protocol, no hello
+  } else if (c.chan && !c.chan->established()) {
+    auto j = Json::parse(payload);
+    if (!j || !c.chan->on_auth(*j)) {
+      return reject_conn(c, c.chan->error().empty() ? "malformed auth frame"
+                                                    : c.chan->error());
+    }
+    return true;
+  } else if (c.chan) {
+    auto pt = c.chan->open_frame(payload);
+    if (!pt) return fail_conn(c, c.chan->error());
+    payload = std::move(*pt);
+  }
+  auto msg = from_payload(payload);
+  if (msg) {
+    ++frames_in_;
+    emit(replica_->receive(*msg));
+  }
+  return true;
 }
 
 void ReplicaServer::flush(Conn& c) {
@@ -412,7 +521,15 @@ void ReplicaServer::check_progress_timer() {
 
 int ReplicaServer::peer_fd(int64_t dest) {
   auto it = peers_.find(dest);
-  if (it != peers_.end() && !it->second->closed) return it->second->fd;
+  if (it != peers_.end()) {
+    if (!it->second->closed) return it->second->fd;
+    // A conn that closed THIS poll iteration may still be referenced by
+    // poll_once's order[] snapshot — replacing it here would free a Conn
+    // the loop still dereferences (use-after-free). Defer the redial to
+    // the next iteration (after the closed entry is swept); the dropped
+    // message is retransmission-covered, as any PBFT loss is.
+    return -1;
+  }
   const auto& ident = cfg_.replicas[dest];
   std::string addr = ident.host + ":" + std::to_string(ident.port);
   if (ident.port == 0) {  // discovery-addressed peer (mDNS equivalent)
@@ -425,6 +542,17 @@ int ReplicaServer::peer_fd(int64_t dest) {
   set_nonblocking(fd);
   auto c = std::make_unique<Conn>();
   c->fd = fd;
+  c->peer_dest = dest;
+  // Link prologue: every peer link opens with a version-carrying hello;
+  // secure clusters start the full handshake (protocol messages queue in
+  // c->pending until it completes).
+  if (cfg_.secure) {
+    c->chan = std::make_unique<SecureChannel>(&cfg_, id_, seed_,
+                                              /*initiator=*/true, dest);
+    c->wbuf += frame_payload(c->chan->initiator_hello());
+  } else {
+    c->wbuf += frame_payload(SecureChannel::plain_hello(id_));
+  }
   peers_[dest] = std::move(c);
   return fd;
 }
@@ -459,7 +587,18 @@ void ReplicaServer::send_to(int64_t dest, const Message& m) {
   }
   if (peer_fd(dest) < 0) return;  // peer down: PBFT tolerates f of these
   Conn& c = *peers_[dest];
-  c.wbuf += to_wire(byzantine_ ? corrupt_sig(m) : m);
+  std::string payload = message_canonical(byzantine_ ? corrupt_sig(m) : m);
+  if (cfg_.secure) {
+    if (!c.chan || !c.chan->established()) {
+      // Handshake in flight: queue (bounded — a wedged handshake must not
+      // buffer without limit; PBFT tolerates the loss via retransmission).
+      if (c.pending.size() < 4096) c.pending.push_back(std::move(payload));
+      flush(c);
+      return;
+    }
+    payload = c.chan->seal_frame(payload);
+  }
+  c.wbuf += frame_payload(payload);
   flush(c);
 }
 

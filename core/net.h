@@ -25,6 +25,7 @@
 
 #include "discovery.h"
 #include "replica.h"
+#include "secure.h"
 #include "verifier.h"
 
 namespace pbft {
@@ -37,6 +38,13 @@ struct Conn {
   bool raw_json = false;   // client-gateway mode (sniffed: first byte '{')
   bool sniffed = false;
   bool closed = false;
+  // Peer-link prologue state (core/secure.cc): every framed peer link
+  // starts with a version-carrying hello; secure clusters run the full
+  // handshake and seal every subsequent frame.
+  int64_t peer_dest = -1;  // >= 0 on dialed (outbound) links
+  bool hello_seen = false;  // inbound: version hello consumed
+  std::unique_ptr<SecureChannel> chan;
+  std::vector<std::string> pending;  // outbound payloads queued pre-handshake
 };
 
 class ReplicaServer {
@@ -88,6 +96,14 @@ class ReplicaServer {
   void handle_readable(Conn& c);
   // Extract complete frames / JSON lines from c.rbuf into the replica.
   void process_buffer(Conn& c);
+  // One framed peer-link payload: handshake routing (hello/auth/reject),
+  // AEAD open on secure links, then protocol dispatch. Returns false when
+  // the connection was closed.
+  bool handle_peer_frame(Conn& c, std::string payload);
+  // Send a reject frame naming the reason, then close. Always false.
+  bool reject_conn(Conn& c, const std::string& reason);
+  // Log + close (no reject frame: the link is beyond a polite refusal).
+  bool fail_conn(Conn& c, const std::string& reason);
   void flush(Conn& c);
   void run_verify_batch();
   void emit(Actions&& actions);
@@ -99,6 +115,7 @@ class ReplicaServer {
 
   ClusterConfig cfg_;
   int64_t id_;
+  uint8_t seed_[32];  // identity seed: signs secure-link handshakes too
   std::unique_ptr<Verifier> verifier_;
   std::unique_ptr<Replica> replica_;
   void trace_batch(int64_t size, int64_t rejected, double secs);

@@ -24,6 +24,7 @@ std::optional<ClusterConfig> ClusterConfig::from_json_text(
   if (const Json* v = j->find("batch_pad")) cfg.batch_pad = v->as_int();
   if (const Json* v = j->find("verifier"); v && v->is_string())
     cfg.verifier = v->as_string();
+  if (const Json* v = j->find("secure")) cfg.secure = v->as_bool();
   const Json* reps = j->find("replicas");
   if (!reps || !reps->is_array()) return std::nullopt;
   for (const Json& r : reps->as_array()) {

@@ -38,6 +38,9 @@ struct ClusterConfig {
   int64_t checkpoint_interval = 16;
   int64_t batch_pad = 64;
   std::string verifier = "cpu";  // "cpu" | "host:port" | "/unix/path"
+  // Encrypted replica-replica links (core/secure.cc; the reference's
+  // development_transport bundles Noise on every link, src/main.rs:42).
+  bool secure = false;
 
   int64_t n() const { return (int64_t)replicas.size(); }
   int64_t f() const { return (n() - 1) / 3; }

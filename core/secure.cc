@@ -1,0 +1,298 @@
+#include "secure.h"
+
+#include <sys/random.h>
+
+#include <cstdio>
+#include <cstring>
+
+#include "blake2b.h"
+#include "ed25519.h"
+#include "messages.h"  // to_hex / from_hex
+
+namespace pbft {
+
+namespace {
+
+constexpr const char* kHsContext = "pbft-tpu-hs1|";
+constexpr const char* kKdfContext = "pbft-tpu-k1|";
+
+void fill_random(uint8_t* out, size_t n) {
+  size_t off = 0;
+  while (off < n) {
+    ssize_t r = getrandom(out + off, n - off, 0);
+    if (r > 0) {
+      off += (size_t)r;
+      continue;
+    }
+    // getrandom unavailable/interrupted: /dev/urandom fallback.
+    FILE* f = std::fopen("/dev/urandom", "rb");
+    if (f) {
+      off += std::fread(out + off, 1, n - off, f);
+      std::fclose(f);
+    }
+  }
+}
+
+// key_dir = keyed-BLAKE2b(shared, "pbft-tpu-k1|" label "|" eph_i "|" eph_r).
+void derive_key(uint8_t out[64], const uint8_t shared[32], const char* label,
+                const uint8_t eph_i[32], const uint8_t eph_r[32]) {
+  std::string data = kKdfContext;
+  data += label;
+  data += '|';
+  data.append((const char*)eph_i, 32);
+  data += '|';
+  data.append((const char*)eph_r, 32);
+  blake2b_keyed(out, 64, shared, 32, (const uint8_t*)data.data(), data.size());
+}
+
+bool ct_equal(const uint8_t* a, const uint8_t* b, size_t n) {
+  uint8_t acc = 0;
+  for (size_t i = 0; i < n; ++i) acc |= a[i] ^ b[i];
+  return acc == 0;
+}
+
+}  // namespace
+
+std::string aead_seal(const uint8_t key[64], uint64_t ctr,
+                      const std::string& plaintext) {
+  uint8_t nonce[12];
+  std::memcpy(nonce, &ctr, 8);  // little-endian hosts only (matches load64)
+  std::string out = plaintext;
+  uint8_t block[64];
+  for (size_t j = 0; j * 64 < plaintext.size(); ++j) {
+    uint32_t j32 = (uint32_t)j;
+    std::memcpy(nonce + 8, &j32, 4);
+    blake2b_keyed(block, 64, key, 32, nonce, 12);
+    size_t n = std::min<size_t>(64, plaintext.size() - j * 64);
+    for (size_t k = 0; k < n; ++k) out[j * 64 + k] ^= block[k];
+  }
+  std::string macin;
+  macin.append((const char*)nonce, 8);
+  macin += out;
+  uint8_t tag[kTagLen];
+  blake2b_keyed(tag, kTagLen, key + 32, 32, (const uint8_t*)macin.data(),
+                macin.size());
+  out.append((const char*)tag, kTagLen);
+  return out;
+}
+
+std::optional<std::string> aead_open(const uint8_t key[64], uint64_t ctr,
+                                     const std::string& sealed) {
+  if (sealed.size() < kTagLen) return std::nullopt;
+  std::string ct = sealed.substr(0, sealed.size() - kTagLen);
+  std::string macin;
+  macin.append((const char*)&ctr, 8);
+  macin += ct;
+  uint8_t tag[kTagLen];
+  blake2b_keyed(tag, kTagLen, key + 32, 32, (const uint8_t*)macin.data(),
+                macin.size());
+  if (!ct_equal(tag, (const uint8_t*)sealed.data() + ct.size(), kTagLen))
+    return std::nullopt;
+  uint8_t nonce[12];
+  std::memcpy(nonce, &ctr, 8);
+  uint8_t block[64];
+  for (size_t j = 0; j * 64 < ct.size(); ++j) {
+    uint32_t j32 = (uint32_t)j;
+    std::memcpy(nonce + 8, &j32, 4);
+    blake2b_keyed(block, 64, key, 32, nonce, 12);
+    size_t n = std::min<size_t>(64, ct.size() - j * 64);
+    for (size_t k = 0; k < n; ++k) ct[j * 64 + k] ^= block[k];
+  }
+  return ct;
+}
+
+SecureChannel::SecureChannel(const ClusterConfig* cfg, int64_t my_id,
+                             const uint8_t identity_seed[32], bool initiator,
+                             int64_t expected_peer)
+    : cfg_(cfg),
+      my_id_(my_id),
+      initiator_(initiator),
+      expected_peer_(expected_peer) {
+  std::memcpy(seed_, identity_seed, 32);
+  fill_random(eph_secret_, 32);
+  ed25519_dh_public(eph_pub_, eph_secret_);
+}
+
+bool SecureChannel::check_version(const Json& obj, std::string* err) {
+  const Json* v = obj.find("ver");
+  std::string ver = v && v->is_string() ? v->as_string() : "<none>";
+  if (ver != kProtocolVersion) {
+    *err = "protocol version mismatch: peer speaks '" + ver +
+           "', this node speaks '" + kProtocolVersion + "'";
+    return false;
+  }
+  return true;
+}
+
+void SecureChannel::transcript(uint8_t out[32]) const {
+  const uint8_t* eph_i = initiator_ ? eph_pub_ : peer_eph_;
+  const uint8_t* eph_r = initiator_ ? peer_eph_ : eph_pub_;
+  std::string data = kHsContext;
+  data += kProtocolVersion;
+  data += '|';
+  data.append((const char*)eph_i, 32);
+  data += '|';
+  data.append((const char*)eph_r, 32);
+  blake2b(out, 32, (const uint8_t*)data.data(), data.size());
+}
+
+bool SecureChannel::verify_peer_sig(const Json& obj, const char* label) {
+  const Json* node = obj.find("node");
+  if (!node || !node->is_int()) {
+    error_ = "handshake frame without node id";
+    return false;
+  }
+  int64_t n = node->as_int();
+  if (expected_peer_ >= 0 && n != expected_peer_) {
+    error_ = "peer claims node " + std::to_string(n) + ", expected " +
+             std::to_string(expected_peer_);
+    return false;
+  }
+  if (n < 0 || n >= cfg_->n()) {
+    error_ = "unknown node id " + std::to_string(n);
+    return false;
+  }
+  const Json* sig = obj.find("sig");
+  uint8_t sigbytes[64];
+  if (!sig || !sig->is_string() || !from_hex(sig->as_string(), sigbytes, 64)) {
+    error_ = "handshake frame without signature";
+    return false;
+  }
+  uint8_t th[32];
+  transcript(th);
+  std::string msg((const char*)th, 32);
+  msg += label;
+  if (!ed25519_verify(cfg_->replicas[n].pubkey, (const uint8_t*)msg.data(),
+                      msg.size(), sigbytes)) {
+    error_ = "bad handshake signature from node " + std::to_string(n);
+    return false;
+  }
+  peer_id_ = n;
+  return true;
+}
+
+bool SecureChannel::finish() {
+  uint8_t shared[32];
+  if (!ed25519_dh_shared(shared, eph_secret_, peer_eph_)) {
+    error_ = "invalid ephemeral key from peer";
+    return false;
+  }
+  const uint8_t* eph_i = initiator_ ? eph_pub_ : peer_eph_;
+  const uint8_t* eph_r = initiator_ ? peer_eph_ : eph_pub_;
+  uint8_t k_i2r[64], k_r2i[64];
+  derive_key(k_i2r, shared, "i2r", eph_i, eph_r);
+  derive_key(k_r2i, shared, "r2i", eph_i, eph_r);
+  std::memcpy(send_key_, initiator_ ? k_i2r : k_r2i, 64);
+  std::memcpy(recv_key_, initiator_ ? k_r2i : k_i2r, 64);
+  established_ = true;
+  return true;
+}
+
+std::string SecureChannel::initiator_hello() {
+  JsonObject o;
+  o["type"] = Json("hello");
+  o["ver"] = Json(kProtocolVersion);
+  o["node"] = Json(my_id_);
+  o["eph"] = Json(to_hex(eph_pub_, 32));
+  return Json(o).dump();
+}
+
+std::optional<std::string> SecureChannel::on_hello(const Json& obj) {
+  if (!check_version(obj, &error_)) return std::nullopt;
+  const Json* eph = obj.find("eph");
+  if (!eph || !eph->is_string() ||
+      !from_hex(eph->as_string(), peer_eph_, 32)) {
+    error_ =
+        "plaintext peer rejected: this cluster requires encrypted links "
+        "(hello carried no ephemeral key)";
+    return std::nullopt;
+  }
+  have_peer_eph_ = true;
+  uint8_t th[32];
+  transcript(th);
+  std::string msg((const char*)th, 32);
+  msg += "|resp";
+  uint8_t sig[64];
+  ed25519_sign(sig, seed_, (const uint8_t*)msg.data(), msg.size());
+  JsonObject o;
+  o["type"] = Json("hello");
+  o["ver"] = Json(kProtocolVersion);
+  o["node"] = Json(my_id_);
+  o["eph"] = Json(to_hex(eph_pub_, 32));
+  o["sig"] = Json(to_hex(sig, 64));
+  return Json(o).dump();
+}
+
+std::optional<std::string> SecureChannel::on_hello_reply(const Json& obj) {
+  const Json* type = obj.find("type");
+  if (type && type->is_string() && type->as_string() == "reject") {
+    const Json* r = obj.find("reason");
+    error_ = "peer rejected handshake: " +
+             (r && r->is_string() ? r->as_string() : "<no reason>");
+    return std::nullopt;
+  }
+  if (!check_version(obj, &error_)) return std::nullopt;
+  const Json* eph = obj.find("eph");
+  if (!eph || !eph->is_string() ||
+      !from_hex(eph->as_string(), peer_eph_, 32)) {
+    error_ = "responder hello carried no ephemeral key";
+    return std::nullopt;
+  }
+  have_peer_eph_ = true;
+  if (!verify_peer_sig(obj, "|resp")) return std::nullopt;
+  uint8_t th[32];
+  transcript(th);
+  std::string msg((const char*)th, 32);
+  msg += "|init";
+  uint8_t sig[64];
+  ed25519_sign(sig, seed_, (const uint8_t*)msg.data(), msg.size());
+  if (!finish()) return std::nullopt;
+  JsonObject o;
+  o["type"] = Json("auth");
+  o["node"] = Json(my_id_);
+  o["sig"] = Json(to_hex(sig, 64));
+  return Json(o).dump();
+}
+
+bool SecureChannel::on_auth(const Json& obj) {
+  if (!have_peer_eph_) {
+    error_ = "auth before hello";
+    return false;
+  }
+  if (!verify_peer_sig(obj, "|init")) return false;
+  return finish();
+}
+
+std::string SecureChannel::seal_frame(const std::string& payload) {
+  return aead_seal(send_key_, send_ctr_++, payload);
+}
+
+std::optional<std::string> SecureChannel::open_frame(
+    const std::string& payload) {
+  auto out = aead_open(recv_key_, recv_ctr_, payload);
+  if (!out) {
+    error_ = "AEAD tag mismatch on frame " + std::to_string(recv_ctr_) +
+             " from node " + std::to_string(peer_id_);
+    return std::nullopt;
+  }
+  ++recv_ctr_;
+  return out;
+}
+
+std::string SecureChannel::reject_payload(const std::string& reason) {
+  JsonObject o;
+  o["type"] = Json("reject");
+  o["reason"] = Json(reason);
+  o["ver"] = Json(kProtocolVersion);
+  return Json(o).dump();
+}
+
+std::string SecureChannel::plain_hello(int64_t my_id) {
+  JsonObject o;
+  o["type"] = Json("hello");
+  o["ver"] = Json(kProtocolVersion);
+  o["node"] = Json(my_id);
+  return Json(o).dump();
+}
+
+}  // namespace pbft

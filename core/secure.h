@@ -1,0 +1,89 @@
+// Authenticated, encrypted replica-replica links for pbftd — the C++ mirror
+// of pbft_tpu/net/secure.py (one spec, two byte-compatible implementations;
+// the module docstring there is the protocol definition). The reference
+// secures every libp2p link with development_transport (Noise + yamux,
+// reference src/main.rs:42) and names its protocol /ackintosh/pbft/1.0.0
+// (reference src/protocol_config.rs:24); this is the rebuild's equivalent:
+// signed ephemeral DH on edwards25519 + keyed-BLAKE2b encrypt-then-MAC,
+// with the protocol version carried in the plaintext hello and rejected
+// cleanly on mismatch.
+#pragma once
+
+#include <cstdint>
+#include <optional>
+#include <string>
+
+#include "json.h"
+#include "replica.h"  // ClusterConfig (identity pubkey table)
+
+namespace pbft {
+
+inline constexpr const char* kProtocolVersion = "pbft-tpu/1.0.0";
+inline constexpr size_t kTagLen = 16;
+
+// Keystream/tag primitive: sealed = ciphertext || 16B tag. key is 64 bytes
+// (enc 32 || mac 32); ctr is the per-direction frame counter.
+std::string aead_seal(const uint8_t key[64], uint64_t ctr,
+                      const std::string& plaintext);
+// Empty optional on tag mismatch (constant-time compare).
+std::optional<std::string> aead_open(const uint8_t key[64], uint64_t ctr,
+                                     const std::string& sealed);
+
+// One connection's handshake state machine + sealed-frame codec.
+class SecureChannel {
+ public:
+  // expected_peer = the dialed replica id (initiator side), or -1 to learn
+  // the peer id from its authenticated handshake frame (responder side).
+  SecureChannel(const ClusterConfig* cfg, int64_t my_id,
+                const uint8_t identity_seed[32], bool initiator,
+                int64_t expected_peer = -1);
+
+  // Initiator's first frame payload.
+  std::string initiator_hello();
+  // Responder: process hello_i -> hello_r payload; nullopt + error() on
+  // failure (version mismatch, plaintext peer, bad ephemeral).
+  std::optional<std::string> on_hello(const Json& obj);
+  // Initiator: process hello_r -> auth payload; channel established.
+  std::optional<std::string> on_hello_reply(const Json& obj);
+  // Responder: process auth_i; channel established.
+  bool on_auth(const Json& obj);
+
+  std::string seal_frame(const std::string& payload);
+  // nullopt on AEAD failure: the connection must drop.
+  std::optional<std::string> open_frame(const std::string& payload);
+
+  bool established() const { return established_; }
+  int64_t peer_id() const { return peer_id_; }
+  const std::string& error() const { return error_; }
+
+  // {"type":"reject","reason":...,"ver":...} payload for clean refusal.
+  static std::string reject_payload(const std::string& reason);
+  // Version-check-only hello for plaintext clusters.
+  static std::string plain_hello(int64_t my_id);
+  // Shared version gate; sets *err on mismatch.
+  static bool check_version(const Json& obj, std::string* err);
+
+ private:
+  void transcript(uint8_t out[32]) const;
+  bool verify_peer_sig(const Json& obj, const char* label);
+  bool finish();
+
+  const ClusterConfig* cfg_;
+  int64_t my_id_;
+  uint8_t seed_[32];
+  bool initiator_;
+  int64_t expected_peer_;
+  int64_t peer_id_ = -1;
+  uint8_t eph_secret_[32];
+  uint8_t eph_pub_[32];
+  uint8_t peer_eph_[32];
+  bool have_peer_eph_ = false;
+  uint8_t send_key_[64];
+  uint8_t recv_key_[64];
+  uint64_t send_ctr_ = 0;
+  uint64_t recv_ctr_ = 0;
+  bool established_ = false;
+  std::string error_;
+};
+
+}  // namespace pbft

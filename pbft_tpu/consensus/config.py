@@ -34,6 +34,10 @@ class ClusterConfig:
     checkpoint_interval: int = 16
     batch_pad: int = 64  # padded batch size fed to the TPU verifier
     verifier: str = "cpu"  # "cpu" | "tpu"
+    # Encrypted replica-replica links (signed-ephemeral DH + AEAD framing,
+    # pbft_tpu/net/secure.py) — the reference's development_transport
+    # bundles Noise encryption on every link (reference src/main.rs:42).
+    secure: bool = False
 
     @property
     def n(self) -> int:
@@ -56,6 +60,7 @@ class ClusterConfig:
                 "checkpoint_interval": self.checkpoint_interval,
                 "batch_pad": self.batch_pad,
                 "verifier": self.verifier,
+                "secure": self.secure,
                 "replicas": [dataclasses.asdict(r) for r in self.replicas],
             },
             indent=2,
@@ -70,6 +75,7 @@ class ClusterConfig:
             checkpoint_interval=d.get("checkpoint_interval", 16),
             batch_pad=d.get("batch_pad", 64),
             verifier=d.get("verifier", "cpu"),
+            secure=bool(d.get("secure", False)),
         )
 
 

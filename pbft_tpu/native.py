@@ -83,6 +83,38 @@ def verify(pub: bytes, msg: bytes, sig: bytes) -> bool:
     return bool(lib().pbft_ed25519_verify(pub, msg, len(msg), sig))
 
 
+def blake2b_keyed(key: bytes, data: bytes, digest_size: int = 32) -> bytes:
+    out = ctypes.create_string_buffer(digest_size)
+    lib().pbft_blake2b_keyed(out, digest_size, key, len(key), data, len(data))
+    return out.raw
+
+
+def dh_public(secret: bytes) -> bytes:
+    out = ctypes.create_string_buffer(32)
+    lib().pbft_dh_public(out, secret)
+    return out.raw
+
+
+def dh_shared(secret: bytes, peer_pub: bytes) -> Optional[bytes]:
+    out = ctypes.create_string_buffer(32)
+    ok = lib().pbft_dh_shared(out, secret, peer_pub)
+    return out.raw if ok else None
+
+
+def aead_seal(key: bytes, ctr: int, plaintext: bytes) -> bytes:
+    out = ctypes.create_string_buffer(len(plaintext) + 16)
+    lib().pbft_aead_seal(key, ctypes.c_uint64(ctr), plaintext, len(plaintext), out)
+    return out.raw
+
+
+def aead_open(key: bytes, ctr: int, sealed: bytes) -> Optional[bytes]:
+    out = ctypes.create_string_buffer(max(len(sealed), 1))
+    fn = lib().pbft_aead_open
+    fn.restype = ctypes.c_long
+    n = fn(key, ctypes.c_uint64(ctr), sealed, len(sealed), out)
+    return out.raw[:n] if n >= 0 else None
+
+
 def verify_batch(items: Sequence[Tuple[bytes, bytes, bytes]]) -> List[bool]:
     """Native batch verify over (pub32, msg32, sig64) triples — the CPU
     control arm with the same call shape as crypto.batch.verify_many."""

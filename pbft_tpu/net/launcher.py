@@ -7,6 +7,7 @@ demand (cmake+ninja, pbft_tpu.native.build)."""
 
 from __future__ import annotations
 
+import dataclasses
 import os
 import socket
 import subprocess
@@ -57,6 +58,7 @@ class LocalCluster:
         seeds: Optional[List[bytes]] = None,
         trace_dir: Optional[str] = None,
         byzantine: Optional[List[int]] = None,
+        secure: bool = False,
     ):
         self.trace_dir = trace_dir
         # Replica ids whose daemons corrupt every outgoing signature
@@ -70,15 +72,14 @@ class LocalCluster:
             # finds peers via multicast beacons (the mDNS-equivalent);
             # otherwise pre-allocate loopback ports in the config.
             ports = [0] * n if discovery else free_ports(n)
-            config = ClusterConfig(
+            config = dataclasses.replace(
+                config,
                 replicas=[
-                    type(r)(r.replica_id, r.host, ports[i], r.pubkey)
+                    dataclasses.replace(r, port=ports[i])
                     for i, r in enumerate(config.replicas)
                 ],
-                watermark_window=config.watermark_window,
-                checkpoint_interval=config.checkpoint_interval,
-                batch_pad=config.batch_pad,
                 verifier=verifier,
+                secure=secure,
             )
         self.config = config
         self.seeds = seeds
@@ -174,15 +175,12 @@ class LocalCluster:
             if time.monotonic() > deadline:
                 raise TimeoutError(f"discovery ports not learned\n{self.logs()}")
             time.sleep(0.05)
-        self.config = ClusterConfig(
+        self.config = dataclasses.replace(
+            self.config,
             replicas=[
-                type(r)(r.replica_id, r.host, ports[i], r.pubkey)
+                dataclasses.replace(r, port=ports[i])
                 for i, r in enumerate(self.config.replicas)
             ],
-            watermark_window=self.config.watermark_window,
-            checkpoint_interval=self.config.checkpoint_interval,
-            batch_pad=self.config.batch_pad,
-            verifier=self.config.verifier,
         )
 
     def _wait_listening(self, timeout: float = 30.0) -> None:

@@ -36,11 +36,23 @@ from ..consensus.messages import (
 )
 from ..consensus.replica import Broadcast, Replica, Reply, Send
 from ..utils import get_tracer
+from . import secure
 
 
-def _frame(msg: Message) -> bytes:
-    payload = msg.canonical()
+def _frame_bytes(payload: bytes) -> bytes:
     return len(payload).to_bytes(4, "big") + payload
+
+
+def _frame_obj(obj: dict) -> bytes:
+    return _frame_bytes(json.dumps(obj, separators=(",", ":")).encode())
+
+
+async def _read_frame(reader, timeout: float = 10.0) -> bytes:
+    hdr = await asyncio.wait_for(reader.readexactly(4), timeout)
+    n = int.from_bytes(hdr, "big")
+    if n > (1 << 24):
+        raise ConnectionError("oversized frame")
+    return await asyncio.wait_for(reader.readexactly(n), timeout)
 
 
 class AsyncReplicaServer:
@@ -81,8 +93,14 @@ class AsyncReplicaServer:
                     ref.verify(p, m, s) for p, m, s in items
                 ]
         self.vc_timeout = vc_timeout
+        self.secure = config.secure
+        self._seed = seed
         self._server: Optional[asyncio.Server] = None
-        self._peer_writers: Dict[int, asyncio.StreamWriter] = {}
+        # dest -> (writer, SecureChannel | None); guarded by a per-dest
+        # lock so one handshake runs per destination and sealed-frame
+        # counters never interleave.
+        self._peer_links: Dict[int, Tuple[asyncio.StreamWriter, Optional[secure.SecureChannel]]] = {}
+        self._peer_locks: Dict[int, asyncio.Lock] = {}
         self._batch_wakeup = asyncio.Event()
         self._stopping = False
         self.listen_port = 0
@@ -114,7 +132,7 @@ class AsyncReplicaServer:
         if self._server:
             self._server.close()
             await self._server.wait_closed()
-        for w in self._peer_writers.values():
+        for w, _ in self._peer_links.values():
             w.close()
 
     # -- inbound ------------------------------------------------------------
@@ -129,8 +147,8 @@ class AsyncReplicaServer:
             if first == b"{":
                 await self._client_connection(first, reader)
             else:
-                await self._peer_connection(first, reader)
-        except (ConnectionError, asyncio.IncompleteReadError):
+                await self._peer_connection(first, reader, writer)
+        except (ConnectionError, asyncio.IncompleteReadError, asyncio.TimeoutError):
             pass
         finally:
             writer.close()
@@ -170,8 +188,19 @@ class AsyncReplicaServer:
             buf += chunk
         self._ingest_client_line(buf)  # trailing JSON without newline
 
-    async def _peer_connection(self, first: bytes, reader) -> None:
+    def _pubkey_of(self, node: int) -> Optional[bytes]:
+        if 0 <= node < self.config.n:
+            return self.config.identity(node).pubkey_bytes()
+        return None
+
+    async def _peer_connection(self, first: bytes, reader, writer) -> None:
+        """Framed replica link. The first frame must be a ``hello`` carrying
+        the protocol version (rejected cleanly on mismatch); in secure
+        clusters the responder side of the handshake runs here and every
+        subsequent frame is AEAD-opened before parsing."""
         buf = first
+        chan: Optional[secure.SecureChannel] = None
+        hello_seen = False
         while True:
             while len(buf) < 4:
                 chunk = await reader.read(65536)
@@ -187,6 +216,53 @@ class AsyncReplicaServer:
                     return
                 buf += chunk
             payload, buf = buf[4 : 4 + n], buf[4 + n :]
+            if not hello_seen or (chan is not None and not chan.established):
+                try:
+                    obj = json.loads(payload)
+                except (ValueError, UnicodeDecodeError):
+                    obj = None
+                try:
+                    if not hello_seen:
+                        if not isinstance(obj, dict) or obj.get("type") != "hello":
+                            if self.secure:
+                                raise secure.HandshakeError(
+                                    "plaintext peer rejected: first frame "
+                                    "must be an encrypted-link hello"
+                                )
+                            # Plaintext cluster: tolerate a missing hello
+                            # (raw protocol frame) for tooling compat.
+                            hello_seen = True
+                        else:
+                            secure.SecureChannel.check_version(obj)
+                            hello_seen = True
+                            if self.secure:
+                                chan = secure.SecureChannel(
+                                    self.id,
+                                    self._seed,
+                                    self._pubkey_of,
+                                    initiator=False,
+                                )
+                                reply = chan.on_hello(obj)
+                                writer.write(_frame_obj(reply))
+                                await writer.drain()
+                            continue
+                    elif chan is not None:
+                        if not isinstance(obj, dict) or obj.get("type") != "auth":
+                            raise secure.HandshakeError("expected auth frame")
+                        chan.on_auth(obj)
+                        continue
+                except secure.HandshakeError as e:
+                    try:
+                        writer.write(_frame_obj(secure.reject_payload(str(e))))
+                        await writer.drain()
+                    except (ConnectionError, OSError):
+                        pass
+                    return
+            if chan is not None:
+                try:
+                    payload = chan.open_frame(payload)
+                except secure.HandshakeError:
+                    return  # tampered/desynced stream: drop the connection
             try:
                 msg = from_wire(payload)
             except (ValueError, KeyError, json.JSONDecodeError):
@@ -253,20 +329,98 @@ class AsyncReplicaServer:
                 )
                 loop.create_task(self._dial_reply(act.client, act.msg))
 
-    async def _send_to(self, dest: int, msg: Message) -> None:
-        writer = self._peer_writers.get(dest)
-        if writer is None or writer.is_closing():
-            ident = self.config.identity(dest)
-            try:
-                _, writer = await asyncio.open_connection(ident.host, ident.port)
-            except OSError:
-                return  # peer down: PBFT tolerates f of these
-            self._peer_writers[dest] = writer
+    async def _open_peer_link(
+        self, dest: int
+    ) -> Optional[Tuple[asyncio.StreamWriter, Optional[secure.SecureChannel]]]:
+        """Dial a peer and run the link prologue: always a hello first
+        frame (protocol version); in secure clusters the full initiator
+        handshake (hello -> hello_r -> auth) before any protocol frame."""
+        ident = self.config.identity(dest)
         try:
-            writer.write(_frame(msg))
+            reader, writer = await asyncio.open_connection(ident.host, ident.port)
+        except OSError:
+            return None  # peer down: PBFT tolerates f of these
+        if not self.secure:
+            writer.write(_frame_obj(secure.plain_hello(self.id)))
+            # A version-mismatched responder answers with a reject frame;
+            # watch for it so the failure is loud (the C++ initiator
+            # read-polls its dialed links for the same reason).
+            asyncio.get_running_loop().create_task(
+                self._watch_plain_link(dest, reader, writer)
+            )
+            return writer, None
+        chan = secure.SecureChannel(
+            self.id,
+            self._seed,
+            self._pubkey_of,
+            initiator=True,
+            expected_peer=dest,
+        )
+        try:
+            writer.write(_frame_obj(chan.initiator_hello()))
             await writer.drain()
-        except (ConnectionError, OSError):
-            self._peer_writers.pop(dest, None)
+            reply = json.loads(await _read_frame(reader))
+            auth = chan.on_hello_reply(reply)
+            writer.write(_frame_obj(auth))
+            await writer.drain()
+        except (
+            secure.HandshakeError,
+            ConnectionError,
+            OSError,
+            asyncio.TimeoutError,
+            asyncio.IncompleteReadError,
+            ValueError,
+        ) as e:
+            print(
+                f"replica {self.id}: handshake with {dest} failed: {e}",
+                flush=True,
+            )
+            writer.close()
+            return None
+        return writer, chan
+
+    async def _watch_plain_link(self, dest: int, reader, writer) -> None:
+        """Surface reject frames arriving on a plaintext dialed link."""
+        try:
+            while True:
+                obj = json.loads(await _read_frame(reader, timeout=3600.0))
+                if isinstance(obj, dict) and obj.get("type") == "reject":
+                    print(
+                        f"replica {self.id}: peer {dest} rejected link: "
+                        f"{obj.get('reason')}",
+                        flush=True,
+                    )
+                    break
+        except (
+            ConnectionError,
+            OSError,
+            asyncio.TimeoutError,
+            asyncio.IncompleteReadError,
+            ValueError,
+        ):
+            return  # EOF/garbage: the send path notices on its next write
+        writer.close()
+        if (link := self._peer_links.get(dest)) and link[0] is writer:
+            self._peer_links.pop(dest, None)
+
+    async def _send_to(self, dest: int, msg: Message) -> None:
+        lock = self._peer_locks.setdefault(dest, asyncio.Lock())
+        async with lock:
+            link = self._peer_links.get(dest)
+            if link is None or link[0].is_closing():
+                link = await self._open_peer_link(dest)
+                if link is None:
+                    return
+                self._peer_links[dest] = link
+            writer, chan = link
+            payload = msg.canonical()
+            if chan is not None:
+                payload = chan.seal_frame(payload)
+            try:
+                writer.write(_frame_bytes(payload))
+                await writer.drain()
+            except (ConnectionError, OSError):
+                self._peer_links.pop(dest, None)
 
     async def _dial_reply(self, client_addr: str, reply: ClientReply) -> None:
         host, _, port = client_addr.rpartition(":")
