@@ -63,6 +63,7 @@ class AsyncReplicaServer:
         seed: bytes,
         verifier: Callable | str = "cpu",
         vc_timeout: float = 0.0,
+        discovery: str = "",
     ):
         self.config = config
         self.id = replica_id
@@ -95,6 +96,9 @@ class AsyncReplicaServer:
         self.vc_timeout = vc_timeout
         self.secure = config.secure
         self._seed = seed
+        self.discovery_target = discovery
+        self._discovery = None
+        self._warned_no_discovery = False
         self._server: Optional[asyncio.Server] = None
         # dest -> (writer, SecureChannel | None); guarded by a per-dest
         # lock so one handshake runs per destination and sealed-frame
@@ -121,6 +125,12 @@ class AsyncReplicaServer:
             self._on_connection, host="0.0.0.0", port=ident.port
         )
         self.listen_port = self._server.sockets[0].getsockname()[1]
+        if self.discovery_target:
+            from .discovery import Discovery
+
+            self._discovery = await Discovery(
+                self.discovery_target, self.id, self.listen_port, self.config.n
+            ).start()
         asyncio.get_running_loop().create_task(self._batch_pump())
         if self.vc_timeout > 0:
             asyncio.get_running_loop().create_task(self._timer_loop())
@@ -129,6 +139,8 @@ class AsyncReplicaServer:
     async def stop(self) -> None:
         self._stopping = True
         self._batch_wakeup.set()
+        if self._discovery:
+            self._discovery.stop()
         if self._server:
             self._server.close()
             await self._server.wait_closed()
@@ -336,8 +348,25 @@ class AsyncReplicaServer:
         frame (protocol version); in secure clusters the full initiator
         handshake (hello -> hello_r -> auth) before any protocol frame."""
         ident = self.config.identity(dest)
+        host, port = ident.host, ident.port
+        if port == 0:  # discovery-addressed peer (the mDNS equivalent)
+            if self._discovery is None:
+                if not self._warned_no_discovery:
+                    self._warned_no_discovery = True
+                    print(
+                        f"replica {self.id}: config lists port-0 peers but "
+                        "discovery is disabled (--discovery); those peers "
+                        "are unreachable",
+                        flush=True,
+                    )
+                return None
+            addr = self._discovery.peers.get(dest)
+            if addr is None:
+                return None  # no beacon yet: retransmission covers the loss
+            host, _, p = addr.rpartition(":")
+            port = int(p)
         try:
-            reader, writer = await asyncio.open_connection(ident.host, ident.port)
+            reader, writer = await asyncio.open_connection(host, port)
         except OSError:
             return None  # peer down: PBFT tolerates f of these
         if not self.secure:
@@ -506,6 +535,7 @@ async def _amain(args) -> None:
         bytes.fromhex(args.seed),
         verifier=args.verifier,
         vc_timeout=args.vc_timeout_ms / 1000.0,
+        discovery=args.discovery,
     )
     await server.start()
     print(
@@ -530,6 +560,11 @@ def main() -> None:
     parser.add_argument("--verifier", default="cpu")
     parser.add_argument("--vc-timeout-ms", type=int, default=0)
     parser.add_argument("--metrics-every", type=int, default=0)
+    parser.add_argument(
+        "--discovery",
+        default="",
+        help="multicast group:port for peer discovery (mDNS equivalent)",
+    )
     parser.add_argument("--trace", default=None, help="JSONL trace file")
     args = parser.parse_args()
     if args.trace:
