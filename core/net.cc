@@ -608,7 +608,13 @@ void ReplicaServer::dial_reply(const std::string& client_addr,
   // reference src/client_handler.rs:75-84): raw JSON + newline, then close.
   int fd = dial_tcp(client_addr);
   if (fd < 0) return;
-  std::string payload = reply.to_json().dump() + "\n";
+  ClientReply out = reply;
+  // The Byzantine signer corrupts EVERY outgoing signature — dial-back
+  // replies included, matching the simulation mutator (bench/harness.py)
+  // and net.h's contract: this replica's reply vote must not count at the
+  // client's f+1 signature-verified quorum.
+  if (byzantine_ && !out.sig.empty()) out.sig.assign(out.sig.size(), 'f');
+  std::string payload = out.to_json().dump() + "\n";
   size_t off = 0;
   while (off < payload.size()) {
     ssize_t w = send(fd, payload.data() + off, payload.size() - off,

@@ -138,13 +138,23 @@ def run_config(
 
 
 def run_native_config(
-    index: int, requests: Optional[int] = None
+    index: int,
+    requests: Optional[int] = None,
+    verifier: str = "cpu",
+    tag: str = "native",
+    trace_dir: Optional[str] = None,
 ) -> BenchResult:
     """The same config driven through REAL pbftd processes over loopback
     TCP (framed wire protocol, dial-back replies) instead of the in-memory
     lockstep simulation — the deployment-shaped number. The Byzantine
     config runs replica n-1 with pbftd --byzantine (every outgoing
-    signature corrupted); the honest 2f+1 must carry every round."""
+    signature corrupted); the honest 2f+1 must carry every round.
+
+    ``verifier`` is the daemon's backend selector: "cpu" (in-process C++
+    Ed25519) or a "host:port" / unix-path address of a running
+    VerifierService — pass a jax-backed service to measure the full
+    deployment shape (N daemons -> coalescing service -> one XLA launch
+    per window)."""
     import re
     import threading
     from pathlib import Path
@@ -157,11 +167,14 @@ def run_native_config(
     reqs_total = requests or max(default_requests, 100)
     per_client = max(1, reqs_total // clients)
     reqs_total = per_client * clients
+    if trace_dir:
+        Path(trace_dir).mkdir(parents=True, exist_ok=True)
     with LocalCluster(
         n=n,
-        verifier="cpu",
+        verifier=verifier,
         metrics_every=1,
         byzantine=[n - 1] if byzantine else None,
+        trace_dir=trace_dir,
     ) as cluster:
         f_val = cluster.config.f
         handles = [PbftClient(cluster.config) for _ in range(clients)]
@@ -206,7 +219,7 @@ def run_native_config(
         rounds_per_sec=round(reqs_total / elapsed, 1),
         sig_verifies_per_sec=round(sig_total / elapsed, 1),
         sig_verifications=sig_total,
-        verifier="native",
+        verifier=tag,
         byzantine=byzantine,
     )
 
@@ -216,6 +229,8 @@ def run_all(arm: str = "cpu", out_path: Optional[str] = None) -> List[BenchResul
     for i in range(len(CONFIGS)):
         if arm == "native":
             res = run_native_config(i)
+        elif arm == "native-tpu":
+            res = run_native_tpu_config(i)
         else:
             res = run_config(i, arm=arm)
         print(res.to_json(), flush=True)
@@ -227,18 +242,63 @@ def run_all(arm: str = "cpu", out_path: Optional[str] = None) -> List[BenchResul
     return results
 
 
+def run_native_tpu_config(
+    index: int,
+    requests: Optional[int] = None,
+    trace_dir: Optional[str] = None,
+) -> BenchResult:
+    """run_native_config against one coalescing jax-backed VerifierService
+    shared by every daemon — the TPU deployment shape (N replicas on one
+    host, one XLA launch per batching window)."""
+    from ..net import VerifierService
+
+    service = VerifierService(backend="jax").start()
+    try:
+        return run_native_config(
+            index,
+            requests=requests,
+            verifier=service.address,
+            tag="native-tpu",
+            trace_dir=trace_dir,
+        )
+    finally:
+        service.stop()
+
+
 def main() -> None:
     import argparse
 
     parser = argparse.ArgumentParser(description=__doc__)
-    parser.add_argument("--arm", default="cpu", choices=["cpu", "jax", "native"])
+    parser.add_argument(
+        "--arm",
+        default="cpu",
+        choices=["cpu", "jax", "native", "native-tpu"],
+        help="native-tpu = real pbftd daemons -> coalescing jax-backed "
+        "VerifierService (the TPU deployment shape)",
+    )
     parser.add_argument("--config", type=int, default=None, help="0-4; default all")
     parser.add_argument("--requests", type=int, default=None)
     parser.add_argument("--out", default=None)
+    parser.add_argument(
+        "--trace-dir",
+        default=None,
+        help="write per-replica JSONL traces here (native arms only) — "
+        "input for scripts/launch_cost_model.py",
+    )
     args = parser.parse_args()
     if args.config is not None:
-        if args.arm == "native":
-            print(run_native_config(args.config, requests=args.requests).to_json())
+        if args.arm == "native-tpu":
+            print(
+                run_native_tpu_config(
+                    args.config, requests=args.requests, trace_dir=args.trace_dir
+                ).to_json()
+            )
+        elif args.arm == "native":
+            print(
+                run_native_config(
+                    args.config, requests=args.requests, trace_dir=args.trace_dir
+                ).to_json()
+            )
         else:
             print(
                 run_config(

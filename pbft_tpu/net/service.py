@@ -144,7 +144,7 @@ class VerifierService:
                 self.requests += 1
                 self.batches += 1
                 self.items += len(items)
-            return self.backend(items)
+            return self._checked(self.backend, items)
         p = _Pending(items)
         with self._cond:
             self.requests += 1
@@ -152,7 +152,11 @@ class VerifierService:
                 raise ConnectionError("verifier service stopping")
             self._pending.append(p)
             self._cond.notify()
-        p.event.wait()
+        # No fixed deadline (a first XLA compile can legitimately take
+        # minutes), but a dead dispatcher must not strand the connection.
+        while not p.event.wait(timeout=1.0):
+            if self._dispatcher is not None and not self._dispatcher.is_alive():
+                raise ConnectionError("verifier dispatcher died")
         if p.error is not None:
             raise ConnectionError(f"verification failed: {p.error!r}")
         assert p.verdicts is not None
@@ -175,32 +179,56 @@ class VerifierService:
                         break
                     size += nxt
                     window.append(self._pending.pop(0))
-            merged: List[Item] = []
-            for p in window:
-                merged.extend(p.items)
             try:
-                verdicts = self.backend(merged)
-            except Exception:
-                # One launch failing must not reject every client's honest
-                # signatures ("never a false reject"): retry each request
-                # alone so only the actually-poisoned one errors out.
-                verdicts = None
-            with self._cond:
-                self.batches += 1
-                self.items += len(merged)
-            if verdicts is None:
+                self._dispatch_window(window)
+            except Exception as e:  # noqa: BLE001 - never strand a handler
+                # Any dispatcher bug outside the backend guard must still
+                # wake every waiting connection with an error rather than
+                # leaving clients hung mid-read.
                 for p in window:
-                    try:
-                        p.verdicts = self.backend(p.items)
-                    except Exception as e:  # noqa: BLE001 - handed to submitter
+                    if not p.event.is_set():
                         p.error = e
-                    p.event.set()
-                continue
-            off = 0
+                        p.event.set()
+
+    @staticmethod
+    def _checked(backend, items: List[Item]) -> List[bool]:
+        """Run the backend and validate the verdict count — a wrong-length
+        result would otherwise mis-slice silently across connections."""
+        verdicts = backend(items)
+        if verdicts is None or len(verdicts) != len(items):
+            got = "None" if verdicts is None else str(len(verdicts))
+            raise ValueError(
+                f"backend returned {got} verdicts for {len(items)} items"
+            )
+        return verdicts
+
+    def _dispatch_window(self, window: List[_Pending]) -> None:
+        merged: List[Item] = []
+        for p in window:
+            merged.extend(p.items)
+        try:
+            verdicts = self._checked(self.backend, merged)
+        except Exception:
+            # One launch failing must not reject every client's honest
+            # signatures ("never a false reject"): retry each request
+            # alone so only the actually-poisoned one errors out.
+            verdicts = None
+        with self._cond:
+            self.batches += 1
+            self.items += len(merged)
+        if verdicts is None:
             for p in window:
-                p.verdicts = verdicts[off : off + len(p.items)]
-                off += len(p.items)
+                try:
+                    p.verdicts = self._checked(self.backend, p.items)
+                except Exception as e:  # noqa: BLE001 - handed to submitter
+                    p.error = e
                 p.event.set()
+            return
+        off = 0
+        for p in window:
+            p.verdicts = verdicts[off : off + len(p.items)]
+            off += len(p.items)
+            p.event.set()
 
     def start(self) -> "VerifierService":
         self._thread = threading.Thread(
