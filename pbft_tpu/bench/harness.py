@@ -143,6 +143,7 @@ def run_native_config(
     verifier: str = "cpu",
     tag: str = "native",
     trace_dir: Optional[str] = None,
+    secure: bool = False,
 ) -> BenchResult:
     """The same config driven through REAL pbftd processes over loopback
     TCP (framed wire protocol, dial-back replies) instead of the in-memory
@@ -175,11 +176,15 @@ def run_native_config(
         metrics_every=1,
         byzantine=[n - 1] if byzantine else None,
         trace_dir=trace_dir,
+        secure=secure,
     ) as cluster:
         f_val = cluster.config.f
         handles = [PbftClient(cluster.config) for _ in range(clients)]
-        warm = handles[0].request("warmup")
-        handles[0].wait_result(warm.timestamp, timeout=30)
+        # Generous warmup with retransmission: against a jax-backed
+        # verifier service the FIRST window triggers the XLA compile
+        # (tens of seconds to minutes on a cold cache), and the paper's
+        # client retry keeps the round alive through it.
+        handles[0].request_with_retry("warmup", timeout=600, retry_every=5)
         t0 = time.perf_counter()
 
         def drive(ci: int) -> None:
@@ -224,13 +229,26 @@ def run_native_config(
     )
 
 
-def run_all(arm: str = "cpu", out_path: Optional[str] = None) -> List[BenchResult]:
+def run_all(
+    arm: str = "cpu",
+    out_path: Optional[str] = None,
+    trace_dir: Optional[str] = None,
+    secure: bool = False,
+) -> List[BenchResult]:
     results = []
     for i in range(len(CONFIGS)):
+        # Per-config trace subdir: configs differ in n, and pbftd appends
+        # to replica-<i>.jsonl — one shared dir would interleave clusters.
+        cfg_traces = f"{trace_dir}/cfg{i}" if trace_dir else None
         if arm == "native":
-            res = run_native_config(i)
+            res = run_native_config(
+                i,
+                trace_dir=cfg_traces,
+                secure=secure,
+                tag="native-secure" if secure else "native",
+            )
         elif arm == "native-tpu":
-            res = run_native_tpu_config(i)
+            res = run_native_tpu_config(i, trace_dir=cfg_traces)
         else:
             res = run_config(i, arm=arm)
         print(res.to_json(), flush=True)
@@ -285,6 +303,12 @@ def main() -> None:
         help="write per-replica JSONL traces here (native arms only) — "
         "input for scripts/launch_cost_model.py",
     )
+    parser.add_argument(
+        "--secure",
+        action="store_true",
+        help="encrypted replica links (native arm only): measures the "
+        "handshake + AEAD overhead at protocol level",
+    )
     args = parser.parse_args()
     if args.config is not None:
         if args.arm == "native-tpu":
@@ -296,7 +320,11 @@ def main() -> None:
         elif args.arm == "native":
             print(
                 run_native_config(
-                    args.config, requests=args.requests, trace_dir=args.trace_dir
+                    args.config,
+                    requests=args.requests,
+                    trace_dir=args.trace_dir,
+                    secure=args.secure,
+                    tag="native-secure" if args.secure else "native",
                 ).to_json()
             )
         else:
@@ -306,7 +334,12 @@ def main() -> None:
                 ).to_json()
             )
     else:
-        run_all(arm=args.arm, out_path=args.out)
+        run_all(
+            arm=args.arm,
+            out_path=args.out,
+            trace_dir=args.trace_dir,
+            secure=args.secure,
+        )
 
 
 if __name__ == "__main__":

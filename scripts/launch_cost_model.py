@@ -38,7 +38,7 @@ import pathlib
 import sys
 
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent))
-from trace_report import load  # noqa: E402
+from trace_report import expand_trace_args, load  # noqa: E402
 
 
 def window_stats(files) -> dict:
@@ -80,11 +80,7 @@ def main() -> None:
     )
     args = parser.parse_args()
 
-    files = []
-    for arg in args.traces:
-        p = pathlib.Path(arg)
-        files.extend(sorted(p.glob("*.jsonl")) if p.is_dir() else [p])
-    win = window_stats(files)
+    win = window_stats(expand_trace_args(args.traces))
 
     kernel = json.loads(pathlib.Path(args.kernel).read_text())
     kernel_rate = float(kernel["value"])  # verifies/sec, launch-amortized

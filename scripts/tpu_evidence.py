@@ -65,6 +65,14 @@ def run_step(name: str, cmd, env_extra=None, timeout=900, out_json=None):
     result = bench._parse_result(stdout)
     if rc != 0:
         log(f"step {name}: rc={rc}")
+    if result is not None and (
+        result.get("error") or ("value" in result and not result.get("value"))
+    ):
+        # A diagnostic/zero-value line is NOT evidence (same acceptance
+        # rule as bench.py's orchestrator) — don't let it become the
+        # round's committed artifact.
+        log(f"step {name}: rejected error result: {result}")
+        result = None
     if out_json and result is not None:
         path = os.path.join(BENCH_DIR, out_json)
         with open(path, "w") as fh:
@@ -169,13 +177,18 @@ print(json.dumps({"batch": B, "chain": 4, "compile_s": round(compile_s, 1),
 
 def main() -> None:
     parser = argparse.ArgumentParser(description=__doc__)
-    parser.add_argument(
-        "--steps", default="kernel,pallas,decomp,profile,protocol"
-    )
+    KNOWN_STEPS = {"kernel", "pallas", "decomp", "profile", "protocol"}
+    parser.add_argument("--steps", default=",".join(sorted(KNOWN_STEPS)))
     parser.add_argument("--skip-probe", action="store_true")
     args = parser.parse_args()
     steps = set(args.steps.split(","))
+    unknown = steps - KNOWN_STEPS
+    if unknown:
+        parser.error(
+            f"unknown steps {sorted(unknown)}; known: {sorted(KNOWN_STEPS)}"
+        )
     os.makedirs(BENCH_DIR, exist_ok=True)
+    failed: list = []
 
     if not args.skip_probe:
         import bench
@@ -186,36 +199,40 @@ def main() -> None:
 
     py = sys.executable
     if "kernel" in steps:
-        run_step(
+        if run_step(
             "kernel-xla",
             [py, "bench.py", "--tpu-worker"],
             env_extra={"PBFT_BENCH_SECS": "5"},
             timeout=900,
             out_json="tpu_r4_kernel_xla.json",
-        )
+        ) is None:
+            failed.append("kernel")
     if "pallas" in steps:
-        run_step(
+        if run_step(
             "kernel-pallas",
             [py, "bench.py", "--tpu-worker"],
             env_extra={"PBFT_BENCH_SECS": "5", "PBFT_PALLAS": "1"},
             timeout=900,
             out_json="tpu_r4_kernel_pallas.json",
-        )
+        ) is None:
+            failed.append("pallas")
     if "decomp" in steps:
-        run_step(
+        if run_step(
             "decomp",
             [py, "-c", DECOMP_CODE % {"repo": REPO}],
             env_extra={"PBFT_FIELD_MUL": "conv"},
             timeout=900,
             out_json="tpu_r4_decomp.json",
-        )
+        ) is None:
+            failed.append("decomp")
     if "profile" in steps:
-        run_step(
+        if run_step(
             "profile",
             [py, "-c", PROFILE_CODE % {"repo": REPO}],
             timeout=900,
             out_json="tpu_r4_profile.json",
-        )
+        ) is None:
+            failed.append("profile")
     if "protocol" in steps:
         # Configs 0-1 (4 replicas): the deployment shape. Larger configs
         # time-slice this box's single core and measure scheduling, not
@@ -245,6 +262,11 @@ def main() -> None:
                 for r in outputs:
                     fh.write(json.dumps(r) + "\n")
             log(f"wrote {path}")
+        else:
+            failed.append("protocol")
+    if failed:
+        log(f"capture INCOMPLETE: no artifact from steps {failed}")
+        sys.exit(1)
     log("capture complete")
 
 

@@ -41,7 +41,9 @@ def report(files) -> dict:
     for path in files:
         events = load(path)
         vb = [e for e in events if e.get("ev") == "verify_batch"]
-        vcs = [e for e in events if e.get("ev") == "view_change"]
+        # Both runtimes emit "view_change_start" (core/net.cc
+        # trace_view_change, server.py _timer_loop).
+        vcs = [e for e in events if e.get("ev") == "view_change_start"]
         sizes = sorted(e["size"] for e in vb)
         secs = sorted(e["secs"] for e in vb)
         rejected = sum(e.get("rejected", 0) for e in vb)
@@ -73,16 +75,24 @@ def report(files) -> dict:
     return total
 
 
-def main() -> None:
-    if len(sys.argv) < 2:
-        sys.exit(__doc__)
+def expand_trace_args(args) -> list:
+    """Directory args expand to their sorted *.jsonl files; file args pass
+    through. Single source of the trace-layout rule (launch_cost_model.py
+    composes with this report and must read the same set)."""
     files = []
-    for arg in sys.argv[1:]:
+    for arg in args:
         p = pathlib.Path(arg)
         if p.is_dir():
             files.extend(sorted(p.glob("*.jsonl")))
         else:
             files.append(p)
+    return files
+
+
+def main() -> None:
+    if len(sys.argv) < 2:
+        sys.exit(__doc__)
+    files = expand_trace_args(sys.argv[1:])
     if not files:
         sys.exit("no trace files found")
     report(files)

@@ -81,16 +81,29 @@ def main() -> None:
     # coordinator barrier: gloo's rendezvous has a ~30s deadline, and the
     # (multi-minute, cold) kernel compile would otherwise skew the two
     # processes' arrival far past it.
-    compiled = certify.lower(*args).compile()
-    try:
-        from jax._src import distributed
+    #
+    # STAGGERED: every process compiles the identical program with the
+    # identical cache key, so process 0 compiles first (alone on this
+    # box's single core) while the others wait at a barrier, then they
+    # compile from the just-written persistent cache in seconds — one
+    # compile total instead of N concurrent ones at 1/N speed each.
+    def barrier(name: str) -> None:
+        try:
+            from jax._src import distributed
 
-        distributed.global_state.client.wait_at_barrier(
-            "pbft_multihost_compiled", timeout_in_ms=900_000
-        )
-    except Exception as e:  # pragma: no cover - barrier API moved
-        print(f"barrier unavailable ({e}); proceeding unsynchronized",
-              file=sys.stderr)
+            distributed.global_state.client.wait_at_barrier(
+                name, timeout_in_ms=900_000
+            )
+        except Exception as e:  # pragma: no cover - barrier API moved
+            print(f"barrier {name} unavailable ({e}); unsynchronized",
+                  file=sys.stderr)
+
+    if pid != 0:
+        barrier("pbft_p0_compiled")
+    compiled = certify.lower(*args).compile()
+    if pid == 0:
+        barrier("pbft_p0_compiled")
+    barrier("pbft_multihost_compiled")
     res = compiled(*args)
     counts = np.asarray(res.counts).tolist()
     certified = np.asarray(res.certified).tolist()

@@ -246,6 +246,30 @@ def test_secure_cxx_cluster_commits():
 
 
 @needs_native
+def test_secure_discovered_cluster_commits():
+    """Discovery + encryption together: peers found via multicast beacons
+    still complete the signed-ephemeral handshake (identity pubkeys come
+    from network.json, never from the unauthenticated beacon channel)."""
+    from pbft_tpu.net import LocalCluster, PbftClient
+
+    with LocalCluster(
+        n=4,
+        verifier="cpu",
+        discovery=True,
+        secure=True,
+        vc_timeout_ms=1500,
+    ) as cluster:
+        client = PbftClient(cluster.config)
+        try:
+            assert (
+                client.request_with_retry("discovered+encrypted", timeout=30)
+                == "awesome!"
+            )
+        finally:
+            client.close()
+
+
+@needs_native
 def test_secure_mixed_runtime_cluster_commits():
     """2 pbftd + 2 asyncio replicas, ALL links encrypted: the handshake and
     AEAD framing interoperate byte-for-byte across the two implementations."""
