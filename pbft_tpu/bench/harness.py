@@ -141,7 +141,7 @@ def run_native_config(
     index: int,
     requests: Optional[int] = None,
     verifier: str = "cpu",
-    tag: str = "native",
+    tag: Optional[str] = None,
     trace_dir: Optional[str] = None,
     secure: bool = False,
 ) -> BenchResult:
@@ -169,7 +169,12 @@ def run_native_config(
     per_client = max(1, reqs_total // clients)
     reqs_total = per_client * clients
     if trace_dir:
+        # Fresh trace set per run: pbftd opens trace files in append mode,
+        # and stale events from a previous run would corrupt the
+        # launch-cost model's occupancy measurement.
         Path(trace_dir).mkdir(parents=True, exist_ok=True)
+        for old in Path(trace_dir).glob("replica-*.jsonl"):
+            old.unlink()
     with LocalCluster(
         n=n,
         verifier=verifier,
@@ -224,7 +229,7 @@ def run_native_config(
         rounds_per_sec=round(reqs_total / elapsed, 1),
         sig_verifies_per_sec=round(sig_total / elapsed, 1),
         sig_verifications=sig_total,
-        verifier=tag,
+        verifier=tag or ("native-secure" if secure else "native"),
         byzantine=byzantine,
     )
 
@@ -241,14 +246,9 @@ def run_all(
         # to replica-<i>.jsonl — one shared dir would interleave clusters.
         cfg_traces = f"{trace_dir}/cfg{i}" if trace_dir else None
         if arm == "native":
-            res = run_native_config(
-                i,
-                trace_dir=cfg_traces,
-                secure=secure,
-                tag="native-secure" if secure else "native",
-            )
+            res = run_native_config(i, trace_dir=cfg_traces, secure=secure)
         elif arm == "native-tpu":
-            res = run_native_tpu_config(i, trace_dir=cfg_traces)
+            res = run_native_tpu_config(i, trace_dir=cfg_traces, secure=secure)
         else:
             res = run_config(i, arm=arm)
         print(res.to_json(), flush=True)
@@ -264,6 +264,7 @@ def run_native_tpu_config(
     index: int,
     requests: Optional[int] = None,
     trace_dir: Optional[str] = None,
+    secure: bool = False,
 ) -> BenchResult:
     """run_native_config against one coalescing jax-backed VerifierService
     shared by every daemon — the TPU deployment shape (N replicas on one
@@ -276,8 +277,9 @@ def run_native_tpu_config(
             index,
             requests=requests,
             verifier=service.address,
-            tag="native-tpu",
+            tag="native-tpu-secure" if secure else "native-tpu",
             trace_dir=trace_dir,
+            secure=secure,
         )
     finally:
         service.stop()
@@ -314,7 +316,10 @@ def main() -> None:
         if args.arm == "native-tpu":
             print(
                 run_native_tpu_config(
-                    args.config, requests=args.requests, trace_dir=args.trace_dir
+                    args.config,
+                    requests=args.requests,
+                    trace_dir=args.trace_dir,
+                    secure=args.secure,
                 ).to_json()
             )
         elif args.arm == "native":
@@ -324,7 +329,6 @@ def main() -> None:
                     requests=args.requests,
                     trace_dir=args.trace_dir,
                     secure=args.secure,
-                    tag="native-secure" if args.secure else "native",
                 ).to_json()
             )
         else:

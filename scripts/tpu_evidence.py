@@ -238,7 +238,8 @@ def main() -> None:
         # time-slice this box's single core and measure scheduling, not
         # the verifier (BASELINE.md "Hardware context").
         outputs = []
-        for cfg in (0, 1):
+        cfgs = (0, 1)
+        for cfg in cfgs:
             res = run_step(
                 f"protocol-{cfg}",
                 [
@@ -262,7 +263,8 @@ def main() -> None:
                 for r in outputs:
                     fh.write(json.dumps(r) + "\n")
             log(f"wrote {path}")
-        else:
+        if len(outputs) < len(cfgs):
+            # A half-empty artifact is not a completed step.
             failed.append("protocol")
     if failed:
         log(f"capture INCOMPLETE: no artifact from steps {failed}")

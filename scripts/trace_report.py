@@ -76,14 +76,16 @@ def report(files) -> dict:
 
 
 def expand_trace_args(args) -> list:
-    """Directory args expand to their sorted *.jsonl files; file args pass
-    through. Single source of the trace-layout rule (launch_cost_model.py
-    composes with this report and must read the same set)."""
+    """Directory args expand to their sorted *.jsonl files, including one
+    level of subdirectories (the harness's --trace-dir writes per-config
+    cfg<i>/ subdirs); file args pass through. Single source of the
+    trace-layout rule (launch_cost_model.py composes with this report and
+    must read the same set)."""
     files = []
     for arg in args:
         p = pathlib.Path(arg)
         if p.is_dir():
-            files.extend(sorted(p.glob("*.jsonl")))
+            files.extend(sorted(p.glob("*.jsonl")) + sorted(p.glob("*/*.jsonl")))
         else:
             files.append(p)
     return files
