@@ -62,8 +62,8 @@ class LocalCluster:
     ):
         self.trace_dir = trace_dir
         # Replica ids whose daemons corrupt every outgoing signature
-        # (pbftd --byzantine; native-runtime analogue of the simulation's
-        # outbound mutator). C++ daemons only.
+        # (--byzantine, both runtimes; the real-daemon analogue of the
+        # simulation's outbound mutator).
         self.byzantine = set(byzantine or [])
         self.discovery = discovery
         if config is None:
@@ -139,8 +139,6 @@ class LocalCluster:
             if self.trace_dir:
                 cmd += ["--trace", str(Path(self.trace_dir) / f"replica-{i}.jsonl")]
             if i in self.byzantine:
-                if self.impl[i] != "cxx":
-                    raise ValueError("byzantine injection is pbftd-only")
                 cmd += ["--byzantine"]
             self._cmds.append((cmd, env))
             self.procs.append(

@@ -33,6 +33,7 @@ from ..consensus.messages import (
     ClientRequest,
     Message,
     from_wire,
+    with_sig,
 )
 from ..consensus.replica import Broadcast, Replica, Reply, Send
 from ..utils import get_tracer
@@ -64,6 +65,7 @@ class AsyncReplicaServer:
         verifier: Callable | str = "cpu",
         vc_timeout: float = 0.0,
         discovery: str = "",
+        byzantine: bool = False,
     ):
         self.config = config
         self.id = replica_id
@@ -99,6 +101,11 @@ class AsyncReplicaServer:
         self.discovery_target = discovery
         self._discovery = None
         self._warned_no_discovery = False
+        # Fault injection (BASELINE config 5, parity with pbftd
+        # --byzantine): corrupt the signature of every outgoing protocol
+        # message AND dial-back reply; self-delivery stays honest (a
+        # Byzantine signer trusts its own messages).
+        self.byzantine = byzantine
         self._server: Optional[asyncio.Server] = None
         # dest -> (writer, SecureChannel | None); guarded by a per-dest
         # lock so one handshake runs per destination and sealed-frame
@@ -432,7 +439,18 @@ class AsyncReplicaServer:
         if (link := self._peer_links.get(dest)) and link[0] is writer:
             self._peer_links.pop(dest, None)
 
+    def _corrupt_sig(self, msg: Message) -> Message:
+        """The Byzantine signer's outgoing message: same content, garbage
+        signature (mirrors core/net.cc corrupt_sig — 'f' * len)."""
+        if not self.byzantine:
+            return msg
+        sig = getattr(msg, "sig", "")
+        if not sig:
+            return msg
+        return with_sig(msg, "f" * len(sig))
+
     async def _send_to(self, dest: int, msg: Message) -> None:
+        msg = self._corrupt_sig(msg)
         lock = self._peer_locks.setdefault(dest, asyncio.Lock())
         async with lock:
             link = self._peer_links.get(dest)
@@ -453,6 +471,7 @@ class AsyncReplicaServer:
 
     async def _dial_reply(self, client_addr: str, reply: ClientReply) -> None:
         host, _, port = client_addr.rpartition(":")
+        reply = self._corrupt_sig(reply)
         try:
             _, writer = await asyncio.open_connection(host, int(port))
             writer.write(reply.canonical() + b"\n")
@@ -536,6 +555,7 @@ async def _amain(args) -> None:
         verifier=args.verifier,
         vc_timeout=args.vc_timeout_ms / 1000.0,
         discovery=args.discovery,
+        byzantine=args.byzantine,
     )
     await server.start()
     print(
@@ -564,6 +584,12 @@ def main() -> None:
         "--discovery",
         default="",
         help="multicast group:port for peer discovery (mDNS equivalent)",
+    )
+    parser.add_argument(
+        "--byzantine",
+        action="store_true",
+        help="fault injection: corrupt every outgoing signature "
+        "(parity with pbftd --byzantine)",
     )
     parser.add_argument("--trace", default=None, help="JSONL trace file")
     args = parser.parse_args()

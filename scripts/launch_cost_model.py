@@ -80,7 +80,19 @@ def main() -> None:
     )
     args = parser.parse_args()
 
-    win = window_stats(expand_trace_args(args.traces))
+    files = expand_trace_args(args.traces)
+    # Occupancy is a PER-CONFIG property: blending several sequentially-run
+    # configs (a parent --trace-dir with cfg<i>/ subdirs) would average
+    # unrelated windows plus the idle gaps between runs into one
+    # plausible-looking but meaningless number. Demand one config's traces.
+    parents = {pathlib.Path(f).parent for f in files}
+    if len(parents) > 1:
+        sys.exit(
+            "traces span multiple directories (one per config?): "
+            f"{sorted(str(p) for p in parents)}\n"
+            "run the model once per config, e.g. --traces <dir>/cfg1"
+        )
+    win = window_stats(files)
 
     kernel = json.loads(pathlib.Path(args.kernel).read_text())
     kernel_rate = float(kernel["value"])  # verifies/sec, launch-amortized

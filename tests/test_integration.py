@@ -233,6 +233,21 @@ def test_mixed_cluster_recovery_via_state_transfer():
             client.close()
 
 
+def test_byzantine_asyncio_backup_tolerated():
+    """--byzantine in the asyncio runtime too (runtime parity): an
+    all-Python cluster with one Byzantine backup corrupting every
+    outgoing signature still commits on the honest 2f+1."""
+    with LocalCluster(
+        n=4, verifier="cpu", impl="py", byzantine=[3]
+    ) as cluster:
+        client = PbftClient(cluster.config)
+        try:
+            req = client.request("py byzantine tolerated")
+            assert client.wait_result(req.timestamp, timeout=20) == "awesome!"
+        finally:
+            client.close()
+
+
 def test_byzantine_backup_tolerated():
     """A backup daemon running with --byzantine (every outgoing signature
     corrupted) cannot stall the cluster: the honest 2f+1 carry each round
