@@ -1,18 +1,33 @@
 """pbft_tpu.net — the host-side runtime glue around the native daemon.
 
-- ``service``  — the JAX/TPU verifier service: the socket server the C++
+- ``server``    — the asyncio replica runtime (in-process JAX verifier).
+- ``service``   — the JAX/TPU verifier service: the socket server the C++
   ``pbftd`` ships signature batches to (core/verifier.h RemoteVerifier);
-  one vmap'd XLA launch per batch.
-- ``client``   — the PBFT client: sends a raw-JSON request to the primary
+  one vmap'd XLA launch per batch, coalesced across daemons.
+- ``secure``    — encrypted replica links + protocol versioning
+  (signed-ephemeral-DH handshake, keyed-BLAKE2b AEAD; mirror of
+  core/secure.cc — the reference's Noise-secured development_transport,
+  reference src/main.rs:42).
+- ``discovery`` — UDP-multicast peer discovery (mirror of
+  core/discovery.cc; the reference's mDNS layer, src/main.rs:46).
+- ``client``    — the PBFT client: sends a raw-JSON request to the primary
   and collects dialed-back replies until f+1 match (PBFT §4.1; the
   reference's manual telnet + ``nc -kl`` walkthrough, README.md:5-43,
   scripted).
-- ``launcher`` — spawns a localhost cluster of ``pbftd`` processes from a
-  ClusterConfig (the reference ran 4 shells by hand).
+- ``launcher``  — spawns a localhost cluster of ``pbftd`` and/or asyncio
+  replicas from a ClusterConfig (the reference ran 4 shells by hand).
 """
 
 from .client import PbftClient
 from .launcher import LocalCluster, pbftd_path
+from .secure import PROTOCOL_VERSION, SecureChannel
 from .service import VerifierService
 
-__all__ = ["PbftClient", "LocalCluster", "VerifierService", "pbftd_path"]
+__all__ = [
+    "PbftClient",
+    "LocalCluster",
+    "VerifierService",
+    "SecureChannel",
+    "PROTOCOL_VERSION",
+    "pbftd_path",
+]

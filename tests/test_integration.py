@@ -169,12 +169,15 @@ def test_remote_verifier_service_path():
         svc.stop()
 
 
-def test_mixed_cluster_recovery_via_state_transfer():
+@pytest.mark.parametrize("secure", [False, True], ids=["plain", "secure"])
+def test_mixed_cluster_recovery_via_state_transfer(secure):
     """Kill a py replica, commit past a checkpoint, revive it with FRESH
     state: it must catch up by fetching the certified checkpoint payload
     from its (C++) peers (PBFT §5.3). A mixed 2cxx+2py cluster can only
     form the checkpoint quorum if both runtimes digest byte-identical
-    payloads, so this doubles as the cross-runtime state-parity test."""
+    payloads, so this doubles as the cross-runtime state-parity test.
+    The secure variant additionally exercises re-handshaking with a
+    revived peer and large (checkpoint-payload) sealed frames."""
     import json
     import time
     from pathlib import Path
@@ -190,6 +193,7 @@ def test_mixed_cluster_recovery_via_state_transfer():
             for i, r in enumerate(config.replicas)
         ],
         checkpoint_interval=4,
+        secure=secure,
     )
     with LocalCluster(
         config=config,
