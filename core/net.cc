@@ -33,12 +33,19 @@ bool split_host_port(const std::string& hp, std::string* host, int* port) {
 
 }  // namespace
 
-int dial_tcp(const std::string& host_port) {
+namespace {
+// Shared dial prologue: resolve, create, (optionally) set nonblocking,
+// connect. One copy so address handling cannot drift between the
+// blocking and nonblocking dialers.
+int dial_socket(const std::string& host_port, bool nonblocking,
+                bool* in_progress) {
+  if (in_progress) *in_progress = false;
   std::string host;
   int port;
   if (!split_host_port(host_port, &host, &port)) return -1;
   int fd = socket(AF_INET, SOCK_STREAM, 0);
   if (fd < 0) return -1;
+  if (nonblocking) set_nonblocking(fd);
   sockaddr_in addr{};
   addr.sin_family = AF_INET;
   addr.sin_port = htons((uint16_t)port);
@@ -47,12 +54,24 @@ int dial_tcp(const std::string& host_port) {
     return -1;
   }
   if (connect(fd, (sockaddr*)&addr, sizeof(addr)) != 0) {
-    close(fd);
-    return -1;
+    if (!nonblocking || errno != EINPROGRESS) {
+      close(fd);
+      return -1;
+    }
+    if (in_progress) *in_progress = true;
   }
   int one = 1;
   setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
   return fd;
+}
+}  // namespace
+
+int dial_tcp(const std::string& host_port) {
+  return dial_socket(host_port, /*nonblocking=*/false, nullptr);
+}
+
+int dial_tcp_nb(const std::string& host_port, bool* in_progress) {
+  return dial_socket(host_port, /*nonblocking=*/true, in_progress);
 }
 
 ReplicaServer::ReplicaServer(ClusterConfig cfg, int64_t id,
@@ -110,31 +129,45 @@ void ReplicaServer::poll_once(int timeout_ms) {
   std::vector<pollfd> pfds;
   pfds.push_back({listen_fd_, POLLIN, 0});
   std::vector<Conn*> order;
-  for (auto& c : conns_) {
-    short ev = POLLIN;
-    if (!c->wbuf.empty()) ev |= POLLOUT;
-    pfds.push_back({c->fd, ev, 0});
-    order.push_back(c.get());
-  }
-  for (auto& [_, c] : peers_) {
-    // Outbound links are read-polled too: handshake replies and reject
-    // frames arrive on the dialed connection.
-    short ev = POLLIN;
-    if (!c->wbuf.empty()) ev |= POLLOUT;
-    pfds.push_back({c->fd, ev, 0});
-    order.push_back(c.get());
-  }
+  auto now = std::chrono::steady_clock::now();
+  auto add_conn = [&](Conn* c) {
+    if (c->closed) return;
+    if (c->connecting) {
+      // Reap dials that never complete (black-holed address): the
+      // deadline bounds how long a one-shot reply or peer link can sit.
+      if (now > c->connect_deadline) {
+        mark_closed(*c);
+        return;
+      }
+      pfds.push_back({c->fd, POLLOUT, 0});  // connect completion only
+    } else {
+      short ev = POLLIN;
+      if (!c->wbuf.empty()) ev |= POLLOUT;
+      pfds.push_back({c->fd, ev, 0});
+    }
+    order.push_back(c);
+  };
+  for (auto& c : conns_) add_conn(c.get());
+  // Outbound links are read-polled too: handshake replies and reject
+  // frames arrive on the dialed connection.
+  for (auto& [_, c] : peers_) add_conn(c.get());
   int n = ::poll(pfds.data(), pfds.size(), timeout_ms);
   if (n < 0) return;
   if (pfds[0].revents & POLLIN) accept_ready();
   for (size_t i = 1; i < pfds.size(); ++i) {
     Conn* c = order[i - 1];
+    if (c->closed) continue;
+    if (c->connecting) {
+      if (pfds[i].revents & (POLLOUT | POLLERR | POLLHUP)) finish_connect(*c);
+      continue;
+    }
     if (pfds[i].revents & (POLLIN | POLLHUP | POLLERR)) handle_readable(*c);
     if ((pfds[i].revents & POLLOUT) && !c->closed) flush(*c);
   }
   // The batching window: everything that arrived this iteration verifies
   // as one batch (one XLA launch on the TPU backend).
   run_verify_batch();
+  pump_reply_backlog();  // launch queued reply dials as slots free
   check_progress_timer();
   if (discovery_) {
     discovery_->poll(&discovered_addrs_);
@@ -182,14 +215,23 @@ void ReplicaServer::handle_readable(Conn& c) {
     if (r < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) break;
     // EOF or error: a raw-JSON client may terminate its message by close.
     if (!c.rbuf.empty()) process_buffer(c);
-    close(c.fd);
-    c.closed = true;
+    mark_closed(c);
     return;
   }
   process_buffer(c);
 }
 
 void ReplicaServer::process_buffer(Conn& c) {
+  if (c.close_when_flushed) {
+    // One-shot outbound reply: anything the dialed endpoint sends back is
+    // discarded, never parsed — the address came from an UNTRUSTED client
+    // request, and feeding its bytes into the replica would be an
+    // unauthenticated request-injection channel. In the common path the
+    // conn closes at flush before reading anything; this guard covers the
+    // partial-flush window where the conn stays open and readable.
+    c.rbuf.clear();
+    return;
+  }
   if (!c.sniffed && !c.rbuf.empty()) {
     c.sniffed = true;
     // The client gateway keeps the reference's telnet-able contract: raw
@@ -214,8 +256,7 @@ void ReplicaServer::process_buffer(Conn& c) {
         if (Json::parse(c.rbuf)) {
           payload.swap(c.rbuf);
         } else if (c.rbuf.size() > (1u << 20)) {
-          close(c.fd);
-          c.closed = true;
+          mark_closed(c);
           return;
         } else {
           return;
@@ -242,8 +283,7 @@ void ReplicaServer::process_buffer(Conn& c) {
     uint32_t len = ((uint8_t)c.rbuf[0] << 24) | ((uint8_t)c.rbuf[1] << 16) |
                    ((uint8_t)c.rbuf[2] << 8) | (uint8_t)c.rbuf[3];
     if (len > (1u << 24)) {  // corrupt frame; drop the connection
-      close(c.fd);
-      c.closed = true;
+      mark_closed(c);
       return;
     }
     if (c.rbuf.size() < 4 + (size_t)len) return;
@@ -273,8 +313,7 @@ bool ReplicaServer::reject_conn(Conn& c, const std::string& reason) {
   c.wbuf += frame_payload(SecureChannel::reject_payload(reason));
   flush(c);  // best-effort: the reject may be truncated if the link stalls
   if (!c.closed) {
-    close(c.fd);
-    c.closed = true;
+    mark_closed(c);
   }
   return false;
 }
@@ -283,8 +322,7 @@ bool ReplicaServer::fail_conn(Conn& c, const std::string& reason) {
   std::fprintf(stderr, "replica %lld: dropping peer link: %s\n",
                (long long)id_, reason.c_str());
   if (!c.closed) {
-    close(c.fd);
-    c.closed = true;
+    mark_closed(c);
   }
   return false;
 }
@@ -363,7 +401,28 @@ bool ReplicaServer::handle_peer_frame(Conn& c, std::string payload) {
   return true;
 }
 
+void ReplicaServer::mark_closed(Conn& c) {
+  if (c.closed) return;
+  if (c.fd >= 0) close(c.fd);
+  c.closed = true;
+  if (c.close_when_flushed && reply_dials_in_flight_ > 0) {
+    --reply_dials_in_flight_;
+  }
+}
+
+void ReplicaServer::finish_connect(Conn& c) {
+  int err = 0;
+  socklen_t len = sizeof(err);
+  if (getsockopt(c.fd, SOL_SOCKET, SO_ERROR, &err, &len) != 0 || err != 0) {
+    mark_closed(c);
+    return;
+  }
+  c.connecting = false;
+  flush(c);  // buffered hello / reply bytes go out now
+}
+
 void ReplicaServer::flush(Conn& c) {
+  if (c.connecting) return;  // nothing sendable until the connect lands
   while (!c.wbuf.empty()) {
     ssize_t w = send(c.fd, c.wbuf.data(), c.wbuf.size(), MSG_NOSIGNAL);
     if (w > 0) {
@@ -371,9 +430,11 @@ void ReplicaServer::flush(Conn& c) {
       continue;
     }
     if (w < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) return;
-    close(c.fd);
-    c.closed = true;
+    mark_closed(c);
     return;
+  }
+  if (c.close_when_flushed) {  // one-shot dial-back reply delivered
+    mark_closed(c);
   }
 }
 
@@ -537,12 +598,15 @@ int ReplicaServer::peer_fd(int64_t dest) {
     if (d == discovered_addrs_.end()) return -1;
     addr = d->second;
   }
-  int fd = dial_tcp(addr);
+  bool in_progress = false;
+  int fd = dial_tcp_nb(addr, &in_progress);
   if (fd < 0) return -1;
-  set_nonblocking(fd);
   auto c = std::make_unique<Conn>();
   c->fd = fd;
   c->peer_dest = dest;
+  c->connecting = in_progress;
+  c->connect_deadline =
+      std::chrono::steady_clock::now() + std::chrono::seconds(10);
   // Link prologue: every peer link opens with a version-carrying hello;
   // secure clusters start the full handshake (protocol messages queue in
   // c->pending until it completes).
@@ -606,23 +670,83 @@ void ReplicaServer::dial_reply(const std::string& client_addr,
                                const ClientReply& reply) {
   // Dial back to the client's advertised address (the reference's contract,
   // reference src/client_handler.rs:75-84): raw JSON + newline, then close.
-  int fd = dial_tcp(client_addr);
-  if (fd < 0) return;
+  // The client address is UNTRUSTED input — the dial is nonblocking and
+  // deadline-bounded so an unroutable address cannot stall the event loop
+  // (the reference dialed synchronously, src/client_handler.rs:75-84).
   ClientReply out = reply;
   // The Byzantine signer corrupts EVERY outgoing signature — dial-back
   // replies included, matching the simulation mutator (bench/harness.py)
   // and net.h's contract: this replica's reply vote must not count at the
   // client's f+1 signature-verified quorum.
   if (byzantine_ && !out.sig.empty()) out.sig.assign(out.sig.size(), 'f');
-  std::string payload = out.to_json().dump() + "\n";
-  size_t off = 0;
-  while (off < payload.size()) {
-    ssize_t w = send(fd, payload.data() + off, payload.size() - off,
-                     MSG_NOSIGNAL);
-    if (w <= 0) break;
-    off += (size_t)w;
+  start_reply_dial(client_addr, out.to_json().dump() + "\n");
+}
+
+// At most this many one-shot reply dials in flight: a pipelined burst can
+// emit dozens of replies in one loop iteration, and firing them all at
+// once overflows small client accept backlogs (the blocking dial this
+// replaced was accidentally self-pacing). Excess replies queue and launch
+// as slots free.
+static constexpr size_t kMaxReplyDialsInFlight = 8;
+static constexpr size_t kMaxReplyBacklog = 10000;
+
+bool ReplicaServer::reply_budget_free() const {
+  return reply_dials_in_flight_ < kMaxReplyDialsInFlight;
+}
+
+// A failed dial drops the reply: the client's retransmission rule
+// re-fetches the cached reply (PBFT §4.1), so loss here is safe.
+void ReplicaServer::reply_dial_now(const std::string& addr,
+                                   std::string payload) {
+  bool in_progress = false;
+  int fd = dial_tcp_nb(addr, &in_progress);
+  if (fd < 0) return;
+  auto c = std::make_unique<Conn>();
+  c->fd = fd;
+  c->connecting = in_progress;
+  // Short deadline: these addresses are UNTRUSTED client input, and each
+  // black-holed dial pins an in-flight slot until reaped — 3s covers a
+  // legitimate listener's SYN retry while bounding the head-of-line harm.
+  c->connect_deadline =
+      std::chrono::steady_clock::now() + std::chrono::seconds(3);
+  c->close_when_flushed = true;
+  c->wbuf = std::move(payload);
+  ++reply_dials_in_flight_;  // mark_closed decrements on every close path
+  flush(*c);
+  if (!c->closed) conns_.push_back(std::move(c));
+}
+
+// Queued replies older than this are dropped (counted): with all
+// in-flight slots pinned by black-holed addresses, an honest reply must
+// not sit in FIFO order for minutes — the client retransmits well before
+// this and the cached reply re-enters the queue near the front.
+static constexpr auto kReplyBacklogTtl = std::chrono::seconds(5);
+
+void ReplicaServer::start_reply_dial(const std::string& addr,
+                                     std::string payload) {
+  if (reply_budget_free()) {
+    reply_dial_now(addr, std::move(payload));
+  } else if (reply_backlog_.size() < kMaxReplyBacklog) {
+    reply_backlog_.push_back(QueuedReply{addr, std::move(payload),
+                                         std::chrono::steady_clock::now()});
+  } else {
+    ++replies_dropped_;  // observable via metrics_json
   }
-  close(fd);
+}
+
+void ReplicaServer::pump_reply_backlog() {
+  auto now = std::chrono::steady_clock::now();
+  while (!reply_backlog_.empty()) {
+    if (now - reply_backlog_.front().enqueued > kReplyBacklogTtl) {
+      reply_backlog_.pop_front();
+      ++replies_dropped_;
+      continue;
+    }
+    if (!reply_budget_free()) return;
+    auto entry = std::move(reply_backlog_.front());
+    reply_backlog_.pop_front();
+    reply_dial_now(entry.addr, std::move(entry.payload));
+  }
 }
 
 std::string ReplicaServer::metrics_json() const {
@@ -631,6 +755,8 @@ std::string ReplicaServer::metrics_json() const {
   o["port"] = Json(listen_port_);
   o["frames_in"] = Json(frames_in_);
   o["verify_batches"] = Json(batches_run_);
+  o["reply_backlog"] = Json((int64_t)reply_backlog_.size());
+  o["replies_dropped"] = Json(replies_dropped_);
   o["executed_upto"] = Json(replica_->executed_upto());
   o["low_mark"] = Json(replica_->low_mark());
   o["view"] = Json(replica_->view());

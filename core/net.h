@@ -18,9 +18,11 @@
 #include <chrono>
 #include <cstdint>
 #include <cstdio>
+#include <deque>
 #include <map>
 #include <memory>
 #include <string>
+#include <utility>
 #include <vector>
 
 #include "discovery.h"
@@ -38,6 +40,15 @@ struct Conn {
   bool raw_json = false;   // client-gateway mode (sniffed: first byte '{')
   bool sniffed = false;
   bool closed = false;
+  // Nonblocking connect in flight: the single-threaded event loop must
+  // never block on a dial (a black-holed peer or a client advertising an
+  // unroutable reply address would stall every replica duty for the TCP
+  // connect timeout). While connecting, writes buffer and flush() no-ops;
+  // poll_once finishes the connect on POLLOUT or reaps it at the deadline.
+  bool connecting = false;
+  std::chrono::steady_clock::time_point connect_deadline{};
+  // Dial-back replies: one-shot connections closed once wbuf drains.
+  bool close_when_flushed = false;
   // Peer-link prologue state (core/secure.cc): every framed peer link
   // starts with a version-carrying hello; secure clusters run the full
   // handshake and seal every subsequent frame.
@@ -94,6 +105,9 @@ class ReplicaServer {
  private:
   void accept_ready();
   void handle_readable(Conn& c);
+  // Resolve an in-flight nonblocking connect (SO_ERROR check) and flush
+  // whatever buffered while it completed.
+  void finish_connect(Conn& c);
   // Extract complete frames / JSON lines from c.rbuf into the replica.
   void process_buffer(Conn& c);
   // One framed peer-link payload: handshake routing (hello/auth/reject),
@@ -109,6 +123,16 @@ class ReplicaServer {
   void emit(Actions&& actions);
   void send_to(int64_t dest, const Message& m);
   void dial_reply(const std::string& client_addr, const ClientReply& reply);
+  // Start one reply dial (nonblocking) if the in-flight budget allows,
+  // else queue it in reply_backlog_.
+  void start_reply_dial(const std::string& addr, std::string payload);
+  bool reply_budget_free() const;
+  void reply_dial_now(const std::string& addr, std::string payload);
+  // Launch queued reply dials while under the in-flight budget.
+  void pump_reply_backlog();
+  // THE close path for conns: closes the fd, marks closed, and keeps the
+  // O(1) reply-dial in-flight counter balanced.
+  void mark_closed(Conn& c);
   int peer_fd(int64_t dest);  // cached outbound connection (lazy dial)
 
   void check_progress_timer();
@@ -145,6 +169,20 @@ class ReplicaServer {
   int listen_fd_ = -1;
   int listen_port_ = 0;
   bool stopping_ = false;
+  // Reply dials beyond the in-flight budget wait here: un-paced one-shot
+  // dials can overflow a client listener's accept backlog and lose
+  // replies to SYN drops. Entries expire after a TTL — black-holed
+  // attacker addresses pinning the in-flight slots must not delay honest
+  // replies beyond the client's retransmit interval (a dropped reply is
+  // re-fetched from the reply cache on retransmission, PBFT §4.1).
+  struct QueuedReply {
+    std::string addr;
+    std::string payload;
+    std::chrono::steady_clock::time_point enqueued;
+  };
+  std::deque<QueuedReply> reply_backlog_;
+  size_t reply_dials_in_flight_ = 0;
+  int64_t replies_dropped_ = 0;  // overflow + TTL expiry (metrics_json)
   std::vector<std::unique_ptr<Conn>> conns_;       // accepted (inbound)
   std::map<int64_t, std::unique_ptr<Conn>> peers_;  // dialed (outbound)
   int64_t batches_run_ = 0;
@@ -153,5 +191,10 @@ class ReplicaServer {
 
 // "host:port" -> connected TCP fd (blocking connect), or -1.
 int dial_tcp(const std::string& host_port);
+
+// Nonblocking dial: returns the fd (or -1 on immediate failure) and sets
+// *in_progress when the connect is still completing (EINPROGRESS) — the
+// caller polls for POLLOUT and checks SO_ERROR.
+int dial_tcp_nb(const std::string& host_port, bool* in_progress);
 
 }  // namespace pbft

@@ -66,6 +66,10 @@ class PbftClient:
         class Server(socketserver.ThreadingTCPServer):
             daemon_threads = True
             allow_reuse_address = True
+            # Every replica dials back per reply; a burst of pipelined
+            # requests means n * pipeline simultaneous connects — far
+            # beyond socketserver's default backlog of 5.
+            request_queue_size = 128
 
         self.server = Server((host, port), Handler)
         self.address = "%s:%d" % self.server.server_address
