@@ -405,8 +405,9 @@ void ReplicaServer::mark_closed(Conn& c) {
   if (c.closed) return;
   if (c.fd >= 0) close(c.fd);
   c.closed = true;
-  if (c.close_when_flushed && reply_dials_in_flight_ > 0) {
-    --reply_dials_in_flight_;
+  if (c.close_when_flushed) {
+    if (reply_dials_in_flight_ > 0) --reply_dials_in_flight_;
+    if (!c.reply_addr.empty()) reply_addrs_in_flight_.erase(c.reply_addr);
   }
 }
 
@@ -710,8 +711,10 @@ void ReplicaServer::reply_dial_now(const std::string& addr,
   c->connect_deadline =
       std::chrono::steady_clock::now() + std::chrono::seconds(3);
   c->close_when_flushed = true;
+  c->reply_addr = addr;
   c->wbuf = std::move(payload);
   ++reply_dials_in_flight_;  // mark_closed decrements on every close path
+  reply_addrs_in_flight_.insert(addr);
   flush(*c);
   if (!c->closed) conns_.push_back(std::move(c));
 }
@@ -724,7 +727,7 @@ static constexpr auto kReplyBacklogTtl = std::chrono::seconds(5);
 
 void ReplicaServer::start_reply_dial(const std::string& addr,
                                      std::string payload) {
-  if (reply_budget_free()) {
+  if (reply_budget_free() && !reply_addrs_in_flight_.count(addr)) {
     reply_dial_now(addr, std::move(payload));
   } else if (reply_backlog_.size() < kMaxReplyBacklog) {
     reply_backlog_.push_back(QueuedReply{addr, std::move(payload),
@@ -735,18 +738,33 @@ void ReplicaServer::start_reply_dial(const std::string& addr,
 }
 
 void ReplicaServer::pump_reply_backlog() {
+  // Per-entry scan (no head-of-line blocking): TTL-expired entries drop,
+  // entries whose address already has a dial in flight stay queued, the
+  // rest launch while the budget lasts.
   auto now = std::chrono::steady_clock::now();
+  std::deque<QueuedReply> keep;
   while (!reply_backlog_.empty()) {
-    if (now - reply_backlog_.front().enqueued > kReplyBacklogTtl) {
-      reply_backlog_.pop_front();
+    auto entry = std::move(reply_backlog_.front());
+    reply_backlog_.pop_front();
+    if (now - entry.enqueued > kReplyBacklogTtl) {
       ++replies_dropped_;
       continue;
     }
-    if (!reply_budget_free()) return;
-    auto entry = std::move(reply_backlog_.front());
-    reply_backlog_.pop_front();
+    if (!reply_budget_free()) {
+      keep.push_back(std::move(entry));
+      while (!reply_backlog_.empty()) {  // budget gone: keep the rest as-is
+        keep.push_back(std::move(reply_backlog_.front()));
+        reply_backlog_.pop_front();
+      }
+      break;
+    }
+    if (reply_addrs_in_flight_.count(entry.addr)) {
+      keep.push_back(std::move(entry));
+      continue;
+    }
     reply_dial_now(entry.addr, std::move(entry.payload));
   }
+  reply_backlog_ = std::move(keep);
 }
 
 std::string ReplicaServer::metrics_json() const {

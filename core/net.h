@@ -21,6 +21,7 @@
 #include <deque>
 #include <map>
 #include <memory>
+#include <set>
 #include <string>
 #include <utility>
 #include <vector>
@@ -49,6 +50,7 @@ struct Conn {
   std::chrono::steady_clock::time_point connect_deadline{};
   // Dial-back replies: one-shot connections closed once wbuf drains.
   bool close_when_flushed = false;
+  std::string reply_addr;  // for the per-address in-flight dedup
   // Peer-link prologue state (core/secure.cc): every framed peer link
   // starts with a version-carrying hello; secure clusters run the full
   // handshake and seal every subsequent frame.
@@ -182,6 +184,10 @@ class ReplicaServer {
   };
   std::deque<QueuedReply> reply_backlog_;
   size_t reply_dials_in_flight_ = 0;
+  // At most ONE in-flight dial per address: a client has one outstanding
+  // request (PBFT §4.1), so honest traffic never needs two, and a
+  // black-holed address can pin at most one slot instead of all of them.
+  std::set<std::string> reply_addrs_in_flight_;
   int64_t replies_dropped_ = 0;  // overflow + TTL expiry (metrics_json)
   std::vector<std::unique_ptr<Conn>> conns_;       // accepted (inbound)
   std::map<int64_t, std::unique_ptr<Conn>> peers_;  // dialed (outbound)

@@ -82,6 +82,46 @@ def test_view_change_on_primary_crash():
             client.close()
 
 
+def test_view_change_on_primary_crash_asyncio():
+    """The same §4.4 liveness path in the ALL-PYTHON runtime: the asyncio
+    timer loop suspects the dead primary and the cluster commits in
+    view >= 1."""
+    with LocalCluster(
+        n=4, verifier="cpu", impl="py", vc_timeout_ms=500
+    ) as cluster:
+        client = PbftClient(cluster.config)
+        try:
+            req = client.request("warmup")
+            assert client.wait_result(req.timestamp, timeout=15) == "awesome!"
+            cluster.kill(0)
+            result = client.request_with_retry(
+                "post-crash-py", timeout=30, retry_every=1.0
+            )
+            assert result == "awesome!"
+        finally:
+            client.close()
+
+
+def test_cascading_view_changes_two_dead_primaries():
+    """Kill primaries of views 0 AND 1 in an f=2 cluster: the remaining
+    2f+1 = 5 replicas must view-change TWICE (exponential-backoff timers,
+    §4.5.2) and still commit — the minimum-quorum worst case for
+    cascading primary failures."""
+    with LocalCluster(n=7, verifier="cpu", vc_timeout_ms=400) as cluster:
+        client = PbftClient(cluster.config)
+        try:
+            req = client.request("warmup")
+            assert client.wait_result(req.timestamp, timeout=15) == "awesome!"
+            cluster.kill(0)
+            cluster.kill(1)
+            result = client.request_with_retry(
+                "post-double-crash", timeout=60, retry_every=1.0
+            )
+            assert result == "awesome!"
+        finally:
+            client.close()
+
+
 def test_multicast_discovery_cluster():
     """All replica ports set to 0: each binds an ephemeral port and finds
     peers via UDP-multicast beacons (the reference's mDNS layer,
