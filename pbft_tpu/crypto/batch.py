@@ -77,11 +77,24 @@ def pad_size(n: int) -> int:
     return ((n + _PAD_LADDER[-1] - 1) // _PAD_LADDER[-1]) * _PAD_LADDER[-1]
 
 
-def verify_many(items, pad_to: int | None = None) -> list[bool]:
-    """Convenience host API: list of (pub, msg, sig) byte triples -> bools."""
+def verify_many(
+    items,
+    pad_to: int | None = None,
+    launch=None,
+    size_multiple: int = 1,
+) -> list[bool]:
+    """Convenience host API: list of (pub, msg, sig) byte triples -> bools.
+
+    ``launch`` overrides the XLA call (e.g. a mesh-sharded jit from
+    pbft_tpu.parallel); ``size_multiple`` rounds the padded size up to a
+    multiple (sharded launches need device-divisible batches). One body
+    for every serving path so pad/slice/verdict handling cannot drift.
+    """
     if not items:
         return []
     size = pad_to or pad_size(len(items))
+    if size % size_multiple:
+        size = ((size + size_multiple - 1) // size_multiple) * size_multiple
     pubs, msgs, sigs, n = pad_batch(items, size)
-    out = np.asarray(verify_batch(pubs, msgs, sigs))
+    out = np.asarray((launch or verify_batch)(pubs, msgs, sigs))
     return [bool(v) for v in out[:n]]

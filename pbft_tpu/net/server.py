@@ -73,9 +73,11 @@ class AsyncReplicaServer:
         if callable(verifier):
             self.verify = verifier
         elif verifier == "jax":
-            from ..crypto import batch
+            # The service-layer backend auto-shards over a multi-device
+            # mesh and reduces to the single-chip path otherwise.
+            from .service import jax_backend
 
-            self.verify = batch.verify_many
+            self.verify = jax_backend
         else:
             # Host CPU arm: the native C++ batch verifier when built
             # (114 us/item), else the pure-Python oracle (~8 ms/item).

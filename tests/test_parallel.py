@@ -44,6 +44,21 @@ def test_sharded_verify_matches_oracle():
     assert out.tolist() == expect
 
 
+def test_verify_many_sharded_serving_path():
+    """The host serving API (used by the verifier service / asyncio
+    runtime on multi-device hosts): same verdicts as the single-device
+    verify_many, including mixed validity, odd batch sizes (padded to a
+    mesh-divisible shape), and the empty batch."""
+    from pbft_tpu.parallel import verify_many_sharded
+
+    items = _signed_items(11, bad={2, 7})
+    out = verify_many_sharded(items)
+    assert out == [i not in {2, 7} for i in range(11)]
+    assert verify_many_sharded([]) == []
+    # Second call reuses the compiled mesh fn (no retrace): same verdicts.
+    assert verify_many_sharded(items[:5]) == [i not in {2} for i in range(5)]
+
+
 def test_quorum_certify_counts_and_thresholds():
     mesh = make_mesh(8)
     R = 4
