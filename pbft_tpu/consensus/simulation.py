@@ -24,10 +24,11 @@ def cpu_verifier(items: List[Tuple[bytes, bytes, bytes]]) -> List[bool]:
 
 
 def jax_verifier(items: List[Tuple[bytes, bytes, bytes]]) -> List[bool]:
-    """The batched XLA verifier (lazy import keeps sims jax-free on cpu arm)."""
-    from ..crypto import batch
+    """The batched XLA verifier (lazy import keeps sims jax-free on cpu
+    arm); auto-shards over a multi-device host like the serving paths."""
+    from ..parallel import verify_many_auto
 
-    return batch.verify_many(items)
+    return verify_many_auto(items)
 
 
 class Cluster:

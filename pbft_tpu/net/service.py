@@ -41,19 +41,11 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
 
 
 def jax_backend(items: List[Item]) -> List[bool]:
-    import jax
+    # Single- or multi-chip is decided in one place (sharded over LOCAL
+    # devices when several; tests/test_parallel.py pins equivalence).
+    from ..parallel import verify_many_auto
 
-    if jax.local_device_count() > 1:
-        # Multi-chip host: shard the window's batch over the LOCAL device
-        # mesh (identical verdicts; tests/test_parallel.py pins
-        # equivalence). local_ matters: under jax.distributed the global
-        # count spans other hosts' non-addressable devices.
-        from ..parallel import verify_many_sharded
-
-        return verify_many_sharded(items)
-    from ..crypto import batch
-
-    return batch.verify_many(items)
+    return verify_many_auto(items)
 
 
 def cpu_backend(items: List[Item]) -> List[bool]:

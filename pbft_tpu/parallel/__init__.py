@@ -13,6 +13,7 @@ from .verifier import (
     QuorumResult,
     make_mesh,
     sharded_verify,
+    verify_many_auto,
     verify_many_sharded,
     quorum_certify,
     round_step,
@@ -28,6 +29,7 @@ __all__ = [
     "QuorumResult",
     "make_mesh",
     "sharded_verify",
+    "verify_many_auto",
     "verify_many_sharded",
     "quorum_certify",
     "round_step",

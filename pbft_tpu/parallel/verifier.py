@@ -113,6 +113,18 @@ def verify_many_sharded(items, pad_to: Optional[int] = None):
     )
 
 
+def verify_many_auto(items, pad_to: Optional[int] = None):
+    """The serving-path selector: mesh-sharded over this host's local
+    devices when there are several, the plain single-device launch
+    otherwise. Every jax-arm consumer (verifier service, asyncio runtime,
+    simulation) routes here so the deployment choice lives in one place."""
+    if jax.local_device_count() > 1:
+        return verify_many_sharded(items, pad_to=pad_to)
+    from ..crypto import batch as _batch
+
+    return _batch.verify_many(items, pad_to=pad_to)
+
+
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass(frozen=True)
 class QuorumResult:
