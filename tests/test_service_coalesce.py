@@ -136,6 +136,38 @@ def test_poison_batch_only_fails_its_own_connection():
         svc.stop()
 
 
+def test_wrong_length_verdicts_fail_loudly():
+    """A backend returning the wrong number of verdicts must error the
+    affected connections, never mis-slice across a merged window or
+    desync the wire protocol (each response is exactly N bytes)."""
+
+    def backend(items):
+        return [True] * (len(items) - 1)  # one verdict short
+
+    svc = VerifierService(backend=backend).start()
+    try:
+        try:
+            out = _send_batch(svc.address, [_item(1, True), _item(2, True)])
+            raised = False
+        except (ConnectionError, OSError, AssertionError):
+            raised = True
+        assert raised, f"short verdicts accepted: {out}"
+    finally:
+        svc.stop()
+
+    # Same contract without coalescing (the handler-thread direct path).
+    svc2 = VerifierService(backend=backend, coalesce=False).start()
+    try:
+        try:
+            out2 = _send_batch(svc2.address, [_item(3, True), _item(4, True)])
+            raised2 = False
+        except (ConnectionError, OSError, AssertionError):
+            raised2 = True
+        assert raised2, f"short verdicts accepted uncoalesced: {out2}"
+    finally:
+        svc2.stop()
+
+
 def test_window_respects_pad_ladder_cap():
     """Merged windows never exceed MAX_WINDOW items (the top of the XLA
     pad ladder) — oversized merges would compile new shapes at runtime."""
