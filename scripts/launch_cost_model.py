@@ -11,7 +11,7 @@ inputs:
                          (pbftd --trace): gives the measured batching-window
                          occupancy (items/launch) and launch frequency.
     --kernel JSON        a committed kernel measurement
-                         (benchmarks/tpu_r4_kernel_xla.json or the bench.py
+                         (benchmarks/tpu_r5_kernel_xla.json or the bench.py
                          output line): sustained verifies/sec at batch B,
                          i.e. launch-amortized kernel time per item.
     --launch-us N        per-launch overhead to model (repeatable).

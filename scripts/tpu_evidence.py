@@ -6,17 +6,18 @@ on-chip in one run, each step in a killable subprocess with its own
 timeout (a mid-step wedge skips to the next step instead of hanging the
 whole capture).
 
-Steps (artifacts under benchmarks/):
-  kernel    bench.py --tpu-worker (XLA arm)      -> tpu_r4_kernel_xla.json
-  pallas    same, PBFT_PALLAS=1                  -> tpu_r4_kernel_pallas.json
-  decomp    on-chip component rates (conv mul    -> tpu_r4_decomp.json
+Steps (artifacts under benchmarks/, <tag> from --tag, default r5):
+  kernel    bench.py --tpu-worker (XLA arm)      -> tpu_<tag>_kernel_xla.json
+  pallas    same, PBFT_PALLAS=1                  -> tpu_<tag>_kernel_pallas.json
+  decomp    on-chip component rates (conv mul    -> tpu_<tag>_decomp.json
             with/without carries, sha512) quantifying the carry-pass share
             behind BASELINE.md's roofline estimate
-  profile   jax.profiler trace of the 4096-batch -> profile_r4/ (xplane)
-  protocol  harness --arm native-tpu (4 pbftd -> -> protocol_r4_tpu.jsonl
+  profile   jax.profiler trace of the 4096-batch -> profile_<tag>/ (xplane)
+  protocol  harness --arm native-tpu (4 pbftd -> -> protocol_<tag>_tpu.jsonl
             coalescing jax VerifierService), configs 0-1
 
-Usage: python scripts/tpu_evidence.py [--steps kernel,pallas,...] [--skip-probe]
+Usage: python scripts/tpu_evidence.py [--steps kernel,...] [--skip-probe]
+                                      [--tag rN]
 """
 
 from __future__ import annotations
@@ -162,7 +163,7 @@ def chained(p, m, s):
 dp, dm, ds = map(jax.device_put, (pubs, msgs, sigs))
 t0 = time.perf_counter(); np.asarray(chained(dp, dm, ds))
 compile_s = time.perf_counter() - t0
-trace_dir = os.path.join(%(repo)r, "benchmarks", "profile_r4")
+trace_dir = os.path.join(%(repo)r, "benchmarks", "profile_%(tag)s")
 with jax.profiler.trace(trace_dir):
     for _ in range(2):
         np.asarray(chained(dp, dm, ds))
@@ -180,7 +181,11 @@ def main() -> None:
     KNOWN_STEPS = {"kernel", "pallas", "decomp", "profile", "protocol"}
     parser.add_argument("--steps", default=",".join(sorted(KNOWN_STEPS)))
     parser.add_argument("--skip-probe", action="store_true")
+    parser.add_argument(
+        "--tag", default="r5", help="round tag baked into artifact names"
+    )
     args = parser.parse_args()
+    tag = args.tag
     steps = set(args.steps.split(","))
     unknown = steps - KNOWN_STEPS
     if unknown:
@@ -204,7 +209,7 @@ def main() -> None:
             [py, "bench.py", "--tpu-worker"],
             env_extra={"PBFT_BENCH_SECS": "5"},
             timeout=900,
-            out_json="tpu_r4_kernel_xla.json",
+            out_json=f"tpu_{tag}_kernel_xla.json",
         ) is None:
             failed.append("kernel")
     if "pallas" in steps:
@@ -213,7 +218,7 @@ def main() -> None:
             [py, "bench.py", "--tpu-worker"],
             env_extra={"PBFT_BENCH_SECS": "5", "PBFT_PALLAS": "1"},
             timeout=900,
-            out_json="tpu_r4_kernel_pallas.json",
+            out_json=f"tpu_{tag}_kernel_pallas.json",
         ) is None:
             failed.append("pallas")
     if "decomp" in steps:
@@ -222,15 +227,15 @@ def main() -> None:
             [py, "-c", DECOMP_CODE % {"repo": REPO}],
             env_extra={"PBFT_FIELD_MUL": "conv"},
             timeout=900,
-            out_json="tpu_r4_decomp.json",
+            out_json=f"tpu_{tag}_decomp.json",
         ) is None:
             failed.append("decomp")
     if "profile" in steps:
         if run_step(
             "profile",
-            [py, "-c", PROFILE_CODE % {"repo": REPO}],
+            [py, "-c", PROFILE_CODE % {"repo": REPO, "tag": tag}],
             timeout=900,
-            out_json="tpu_r4_profile.json",
+            out_json=f"tpu_{tag}_profile.json",
         ) is None:
             failed.append("profile")
     if "protocol" in steps:
@@ -251,20 +256,22 @@ def main() -> None:
                     "--config",
                     str(cfg),
                     "--trace-dir",
-                    os.path.join(BENCH_DIR, f"traces_r4_tpu_cfg{cfg}"),
+                    os.path.join(BENCH_DIR, f"traces_{tag}_tpu_cfg{cfg}"),
                 ],
                 timeout=1200,
             )
             if res is not None:
                 outputs.append(res)
-        if outputs:
-            path = os.path.join(BENCH_DIR, "protocol_r4_tpu.jsonl")
+        if len(outputs) == len(cfgs):
+            path = os.path.join(BENCH_DIR, f"protocol_{tag}_tpu.jsonl")
             with open(path, "w") as fh:
                 for r in outputs:
                     fh.write(json.dumps(r) + "\n")
             log(f"wrote {path}")
-        if len(outputs) < len(cfgs):
-            # A half-empty artifact is not a completed step.
+        else:
+            # A half-empty artifact is not a completed step — and writing
+            # it anyway would read as "done" to tpu_watch's artifact-
+            # existence resume check, permanently skipping the retry.
             failed.append("protocol")
     if failed:
         log(f"capture INCOMPLETE: no artifact from steps {failed}")

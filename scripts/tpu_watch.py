@@ -1,0 +1,150 @@
+#!/usr/bin/env python
+"""Round-long opportunistic TPU capture (VERDICT r4 item 1).
+
+Two consecutive rounds lost their on-chip evidence to tunnel outages
+because capture only ran inside bench-time probe budgets (~13 min)
+against multi-hour wedges. This watcher inverts that: it runs for the
+WHOLE round, probing the tunnel every few minutes from a disposable
+subprocess, and the moment the tunnel answers it runs
+scripts/tpu_evidence.py end-to-end and commits every artifact it
+produced — so by scoring time the round carries driver-visible on-chip
+numbers and a warm compile cache no matter when (or whether) the tunnel
+was up at bench time.
+
+Partial capture is kept: each wake-up re-derives the remaining steps
+from which artifacts already exist, so a tunnel window long enough for
+only the kernel step still lands the kernel number, and a later window
+finishes the rest.
+
+Usage: python scripts/tpu_watch.py [--tag r5] [--interval 180]
+                                   [--max-hours 11] [--once]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "benchmarks")
+T0 = time.monotonic()
+
+
+def log(msg: str) -> None:
+    print(f"[tpu_watch +{time.monotonic() - T0:8.1f}s] {msg}", flush=True)
+
+
+def probe(timeout_s: float = 60.0) -> bool:
+    """One disposable-subprocess tunnel probe — bench.py's helper (the
+    single source of the wedge-safe probe recipe), one attempt per wake."""
+    sys.path.insert(0, REPO)
+    import bench
+
+    return bench._probe_tpu(timeout_s=timeout_s, attempts=1, gap_s=0.0)
+
+
+def remaining_steps(tag: str) -> list:
+    """Steps whose artifact does not exist yet."""
+    artifacts = {
+        "kernel": f"tpu_{tag}_kernel_xla.json",
+        "pallas": f"tpu_{tag}_kernel_pallas.json",
+        "decomp": f"tpu_{tag}_decomp.json",
+        "profile": f"tpu_{tag}_profile.json",
+        "protocol": f"protocol_{tag}_tpu.jsonl",
+    }
+    return [
+        step
+        for step, name in artifacts.items()
+        if not os.path.exists(os.path.join(BENCH, name))
+    ]
+
+
+def git_commit(tag: str) -> None:
+    """Commit whatever capture artifacts exist under benchmarks/. Retries
+    around the index lock: the builder session commits concurrently with
+    this watcher. (.jax_cache is gitignored; warm compiles persist on disk
+    for the same-workspace bench run without going through git.)"""
+    msg = (
+        f"Capture on-chip {tag} benchmark artifacts\n\n"
+        "Recorded by scripts/tpu_watch.py during a live tunnel window.\n\n"
+        "No-Verification-Needed: benchmark artifact data only"
+    )
+    for attempt in range(6):
+        add = subprocess.run(
+            ["git", "add", "-A", "--", "benchmarks"],
+            cwd=REPO,
+            capture_output=True,
+        )
+        diff = subprocess.run(
+            ["git", "diff", "--cached", "--quiet", "--", "benchmarks"], cwd=REPO
+        )
+        if add.returncode == 0 and diff.returncode == 0:
+            log("git: nothing new to commit")
+            return
+        # Pathspec-limited commit: the builder session works (and stages)
+        # concurrently in this repo — only the capture paths may land here.
+        commit = subprocess.run(
+            ["git", "commit", "-m", msg, "--", "benchmarks"],
+            cwd=REPO,
+            capture_output=True,
+            text=True,
+        )
+        if commit.returncode == 0:
+            log("git: committed capture artifacts")
+            return
+        log(f"git: commit attempt {attempt + 1} failed: {commit.stderr.strip()[:200]}")
+        time.sleep(5)
+    log("git: giving up; artifacts remain in the working tree")
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--tag", default="r5")
+    parser.add_argument("--interval", type=float, default=180.0)
+    parser.add_argument("--max-hours", type=float, default=11.0)
+    parser.add_argument("--once", action="store_true", help="single probe+capture attempt")
+    args = parser.parse_args()
+
+    deadline = time.monotonic() + args.max_hours * 3600.0
+    probes = 0
+    while time.monotonic() < deadline:
+        steps = remaining_steps(args.tag)
+        if not steps:
+            log("all artifacts present; done")
+            git_commit(args.tag)
+            return
+        probes += 1
+        if probe():
+            log(f"tunnel UP after {probes} probes; capturing steps {steps}")
+            rc = subprocess.run(
+                [
+                    sys.executable,
+                    os.path.join(REPO, "scripts", "tpu_evidence.py"),
+                    "--tag",
+                    args.tag,
+                    "--skip-probe",
+                    "--steps",
+                    ",".join(steps),
+                ],
+                cwd=REPO,
+            ).returncode
+            log(f"tpu_evidence rc={rc}")
+            git_commit(args.tag)
+            if rc == 0 and not remaining_steps(args.tag):
+                log("capture complete; exiting")
+                return
+            # Partial success (or mid-capture wedge): keep watching.
+        elif probes % 10 == 1:
+            log(f"tunnel down (probe {probes})")
+        if args.once:
+            return
+        time.sleep(args.interval)
+    log("max-hours budget exhausted")
+    git_commit(args.tag)
+
+
+if __name__ == "__main__":
+    main()
