@@ -126,6 +126,16 @@ void ReplicaServer::run() {
 }
 
 void ReplicaServer::poll_once(int timeout_ms) {
+  if (verify_window_open_) {
+    // An open accumulation window caps how long we may sit in poll():
+    // the flush deadline is a latency promise, not a hint.
+    auto deadline =
+        verify_window_start_ + std::chrono::microseconds(cfg_.verify_flush_us);
+    auto rem = std::chrono::duration_cast<std::chrono::milliseconds>(
+                   deadline - std::chrono::steady_clock::now())
+                   .count();
+    timeout_ms = std::min<int64_t>(timeout_ms, std::max<int64_t>(rem, 0) + 1);
+  }
   std::vector<pollfd> pfds;
   pfds.push_back({listen_fd_, POLLIN, 0});
   std::vector<Conn*> order;
@@ -483,8 +493,30 @@ void ReplicaServer::trace_view_change(int backoff) {
 }
 
 void ReplicaServer::run_verify_batch() {
+  size_t pending = replica_->pending_count();
+  if (pending == 0) {
+    verify_window_open_ = false;
+    return;
+  }
+  if (cfg_.verify_flush_us > 0) {
+    // Bounded accumulation: hold the queue until the item target or the
+    // deadline so one verifier launch carries a whole window instead of
+    // one event-loop pass's trickle (network.json verify_flush_us/_items).
+    int64_t target =
+        cfg_.verify_flush_items > 0 ? cfg_.verify_flush_items : cfg_.batch_pad;
+    auto now = std::chrono::steady_clock::now();
+    if (!verify_window_open_) {
+      verify_window_open_ = true;
+      verify_window_start_ = now;
+    }
+    if ((int64_t)pending < target &&
+        now - verify_window_start_ <
+            std::chrono::microseconds(cfg_.verify_flush_us)) {
+      return;
+    }
+    verify_window_open_ = false;
+  }
   auto items = replica_->pending_items();
-  if (items.empty()) return;
   ++batches_run_;
   auto t0 = std::chrono::steady_clock::now();
   auto verdicts = verifier_->verify_batch(items);

@@ -193,6 +193,13 @@ class ReplicaServer {
   std::map<int64_t, std::unique_ptr<Conn>> peers_;  // dialed (outbound)
   int64_t batches_run_ = 0;
   int64_t frames_in_ = 0;
+  // Bounded verify accumulation (ClusterConfig::verify_flush_us): the
+  // window opens when the first item queues and flushes at the item
+  // target or the deadline, whichever comes first. poll_once clamps its
+  // poll timeout to the deadline so a quiet socket can't stretch the
+  // promised latency bound.
+  bool verify_window_open_ = false;
+  std::chrono::steady_clock::time_point verify_window_start_{};
 };
 
 // "host:port" -> connected TCP fd (blocking connect), or -1.

@@ -22,6 +22,10 @@ std::optional<ClusterConfig> ClusterConfig::from_json_text(
   if (const Json* v = j->find("checkpoint_interval"))
     cfg.checkpoint_interval = v->as_int();
   if (const Json* v = j->find("batch_pad")) cfg.batch_pad = v->as_int();
+  if (const Json* v = j->find("verify_flush_us"))
+    cfg.verify_flush_us = v->as_int();
+  if (const Json* v = j->find("verify_flush_items"))
+    cfg.verify_flush_items = v->as_int();
   if (const Json* v = j->find("verifier"); v && v->is_string())
     cfg.verifier = v->as_string();
   if (const Json* v = j->find("secure")) cfg.secure = v->as_bool();

@@ -37,6 +37,14 @@ struct ClusterConfig {
   int64_t watermark_window = 256;
   int64_t checkpoint_interval = 16;
   int64_t batch_pad = 64;
+  // Bounded verify accumulation (BASELINE north-star lever): when
+  // verify_flush_us > 0, a replica holds its verify queue until
+  // verify_flush_items are pending (0 = batch_pad) or the oldest item has
+  // waited verify_flush_us — trading that much latency for a fatter
+  // batching window (more items per verifier launch). 0 = flush every
+  // event-loop pass (the original behavior).
+  int64_t verify_flush_us = 0;
+  int64_t verify_flush_items = 0;
   std::string verifier = "cpu";  // "cpu" | "host:port" | "/unix/path"
   // Encrypted replica-replica links (core/secure.cc; the reference's
   // development_transport bundles Noise on every link, src/main.rs:42).
@@ -88,6 +96,9 @@ class Replica {
   // Replica-to-replica: queue for batched signature verification.
   Actions receive(const Message& msg);
   std::vector<VerifyItem> pending_items() const;
+  // Queue depth without building the items — the event loop's bounded
+  // accumulation (verify_flush_us) checks this every pass.
+  size_t pending_count() const { return inbox_.size(); }
   Actions deliver_verdicts(const std::vector<uint8_t>& verdicts);
 
   // View change (PBFT §4.4): called by the runtime when its request timer

@@ -18,6 +18,7 @@ constexpr const char* kKdfContext = "pbft-tpu-k1|";
 
 void fill_random(uint8_t* out, size_t n) {
   size_t off = 0;
+  int failures = 0;
   while (off < n) {
     ssize_t r = getrandom(out + off, n - off, 0);
     if (r > 0) {
@@ -25,12 +26,37 @@ void fill_random(uint8_t* out, size_t n) {
       continue;
     }
     // getrandom unavailable/interrupted: /dev/urandom fallback.
+    size_t got = 0;
     FILE* f = std::fopen("/dev/urandom", "rb");
     if (f) {
-      off += std::fread(out + off, 1, n - off, f);
+      got = std::fread(out + off, 1, n - off, f);
       std::fclose(f);
     }
+    off += got;
+    if (got == 0 && ++failures >= 16) {
+      // No entropy source at all (e.g. a chroot without device nodes):
+      // fail closed with a diagnostic — a CSPRNG-less handshake must
+      // never proceed, and a silent spin here would look like a hang.
+      std::fprintf(stderr,
+                   "pbft secure: no entropy source (getrandom and "
+                   "/dev/urandom both failed); aborting\n");
+      std::abort();
+    }
   }
+}
+
+// The AEAD counter is protocol data (nonce prefix + MAC input): serialize
+// it explicitly little-endian so the byte compatibility with the Python
+// runtime (net/secure.py uses int.to_bytes(..., "little")) holds on
+// big-endian hosts too — a raw memcpy of the uint64 would silently fail
+// every cross-runtime tag check there.
+void store64_le(uint8_t out[8], uint64_t v) {
+  for (int i = 0; i < 8; ++i) out[i] = (uint8_t)(v >> (8 * i));
+}
+
+// Same for the keystream block counter (secure.py: j.to_bytes(4, "little")).
+void store32_le(uint8_t out[4], uint32_t v) {
+  for (int i = 0; i < 4; ++i) out[i] = (uint8_t)(v >> (8 * i));
 }
 
 // key_dir = keyed-BLAKE2b(shared, "pbft-tpu-k1|" label "|" eph_i "|" eph_r).
@@ -56,12 +82,11 @@ bool ct_equal(const uint8_t* a, const uint8_t* b, size_t n) {
 std::string aead_seal(const uint8_t key[64], uint64_t ctr,
                       const std::string& plaintext) {
   uint8_t nonce[12];
-  std::memcpy(nonce, &ctr, 8);  // little-endian hosts only (matches load64)
+  store64_le(nonce, ctr);
   std::string out = plaintext;
   uint8_t block[64];
   for (size_t j = 0; j * 64 < plaintext.size(); ++j) {
-    uint32_t j32 = (uint32_t)j;
-    std::memcpy(nonce + 8, &j32, 4);
+    store32_le(nonce + 8, (uint32_t)j);
     blake2b_keyed(block, 64, key, 32, nonce, 12);
     size_t n = std::min<size_t>(64, plaintext.size() - j * 64);
     for (size_t k = 0; k < n; ++k) out[j * 64 + k] ^= block[k];
@@ -80,8 +105,10 @@ std::optional<std::string> aead_open(const uint8_t key[64], uint64_t ctr,
                                      const std::string& sealed) {
   if (sealed.size() < kTagLen) return std::nullopt;
   std::string ct = sealed.substr(0, sealed.size() - kTagLen);
+  uint8_t ctr_le[8];
+  store64_le(ctr_le, ctr);
   std::string macin;
-  macin.append((const char*)&ctr, 8);
+  macin.append((const char*)ctr_le, 8);
   macin += ct;
   uint8_t tag[kTagLen];
   blake2b_keyed(tag, kTagLen, key + 32, 32, (const uint8_t*)macin.data(),
@@ -89,11 +116,10 @@ std::optional<std::string> aead_open(const uint8_t key[64], uint64_t ctr,
   if (!ct_equal(tag, (const uint8_t*)sealed.data() + ct.size(), kTagLen))
     return std::nullopt;
   uint8_t nonce[12];
-  std::memcpy(nonce, &ctr, 8);
+  store64_le(nonce, ctr);
   uint8_t block[64];
   for (size_t j = 0; j * 64 < ct.size(); ++j) {
-    uint32_t j32 = (uint32_t)j;
-    std::memcpy(nonce + 8, &j32, 4);
+    store32_le(nonce + 8, (uint32_t)j);
     blake2b_keyed(block, 64, key, 32, nonce, 12);
     size_t n = std::min<size_t>(64, ct.size() - j * 64);
     for (size_t k = 0; k < n; ++k) ct[j * 64 + k] ^= block[k];

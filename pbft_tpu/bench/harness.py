@@ -36,6 +36,7 @@ class BenchResult:
     sig_verifications: int
     verifier: str
     byzantine: bool = False
+    pipeline: int = 1  # in-flight requests per nominal client (native arms)
 
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self))
@@ -72,6 +73,15 @@ CONFIGS = [
     ("f=5 large-batch", 16, 8, 50, False),
     ("f=10 byzantine-signer", 31, 8, 12, True),
 ]
+
+# In-flight requests per nominal client on the NATIVE arms, by config
+# index. BASELINE's firehose is "client firehose @ 1k req/s" — an arrival
+# rate far above the per-round latency, i.e. deep pipelining: the load
+# generator keeps this many requests in flight (each on its own reply
+# listener identity), and the replicas batch verification across the
+# concurrent sequence numbers (SURVEY.md §7 "batch across pipelined
+# rounds"). The lockstep simulation arms keep one request per client.
+PIPELINE = {1: 32}
 
 
 def run_config(
@@ -144,6 +154,9 @@ def run_native_config(
     tag: Optional[str] = None,
     trace_dir: Optional[str] = None,
     secure: bool = False,
+    pipeline: Optional[int] = None,
+    flush_us: int = 0,
+    flush_items: int = 0,
 ) -> BenchResult:
     """The same config driven through REAL pbftd processes over loopback
     TCP (framed wire protocol, dial-back replies) instead of the in-memory
@@ -163,11 +176,15 @@ def run_native_config(
     from ..net import LocalCluster, PbftClient
 
     name, n, clients, default_requests, byzantine = CONFIGS[index]
+    if pipeline is None:
+        pipeline = PIPELINE.get(index, 1)
+    workers = clients * pipeline
     # The native runtime pipelines across rounds, so give it enough
-    # requests to measure steady state even on the demo config.
-    reqs_total = requests or max(default_requests, 100)
-    per_client = max(1, reqs_total // clients)
-    reqs_total = per_client * clients
+    # requests to measure steady state even on the demo config (and at
+    # least a few rounds per in-flight slot when pipelined).
+    reqs_total = requests or max(default_requests, 100, workers * 6)
+    per_worker = max(1, reqs_total // workers)
+    reqs_total = per_worker * workers
     if trace_dir:
         # Fresh trace set per run: pbftd opens trace files in append mode,
         # and stale events from a previous run would corrupt the
@@ -182,9 +199,11 @@ def run_native_config(
         byzantine=[n - 1] if byzantine else None,
         trace_dir=trace_dir,
         secure=secure,
+        verify_flush_us=flush_us,
+        verify_flush_items=flush_items,
     ) as cluster:
         f_val = cluster.config.f
-        handles = [PbftClient(cluster.config) for _ in range(clients)]
+        handles = [PbftClient(cluster.config) for _ in range(workers)]
         # Generous warmup with retransmission: against a jax-backed
         # verifier service the FIRST window triggers the XLA compile
         # (tens of seconds to minutes on a cold cache), and the paper's
@@ -194,12 +213,12 @@ def run_native_config(
 
         def drive(ci: int) -> None:
             c = handles[ci]
-            for k in range(per_client):
+            for k in range(per_worker):
                 req = c.request(f"op-{ci}-{k}")
                 c.wait_result(req.timestamp, timeout=60)
 
         threads = [
-            threading.Thread(target=drive, args=(i,)) for i in range(clients)
+            threading.Thread(target=drive, args=(i,)) for i in range(workers)
         ]
         for t in threads:
             t.start()
@@ -231,6 +250,7 @@ def run_native_config(
         sig_verifications=sig_total,
         verifier=tag or ("native-secure" if secure else "native"),
         byzantine=byzantine,
+        pipeline=pipeline,
     )
 
 
@@ -265,21 +285,47 @@ def run_native_tpu_config(
     requests: Optional[int] = None,
     trace_dir: Optional[str] = None,
     secure: bool = False,
+    pipeline: Optional[int] = None,
+    flush_us: int = 0,
+    flush_items: int = 0,
+    service_backend: str = "jax",
 ) -> BenchResult:
-    """run_native_config against one coalescing jax-backed VerifierService
-    shared by every daemon — the TPU deployment shape (N replicas on one
-    host, one XLA launch per batching window)."""
+    """run_native_config against one coalescing VerifierService shared by
+    every daemon — the TPU deployment shape (N replicas on one host, one
+    XLA launch per batching window). ``service_backend="native"`` swaps
+    the chip for the C++ batch verifier: same wire path and coalescing,
+    useful for measuring merged-window occupancy on a box without a TPU.
+
+    The service's own per-dispatch trace (the honest items-per-LAUNCH
+    measurement — per-replica traces only see each daemon's share of a
+    merged window) lands in <trace_dir>-service/service.jsonl."""
+    import os
+
     from ..net import VerifierService
 
-    service = VerifierService(backend="jax").start()
+    service_trace = None
+    if trace_dir:
+        service_trace_dir = f"{trace_dir.rstrip('/')}-service"
+        os.makedirs(service_trace_dir, exist_ok=True)
+        service_trace = os.path.join(service_trace_dir, "service.jsonl")
+        if os.path.exists(service_trace):
+            os.unlink(service_trace)  # append mode; stale events corrupt
+    service = VerifierService(
+        backend=service_backend,
+        flush_us=flush_us,
+        flush_items=flush_items,
+        trace_path=service_trace,
+    ).start()
     try:
         return run_native_config(
             index,
             requests=requests,
             verifier=service.address,
-            tag="native-tpu-secure" if secure else "native-tpu",
+            tag=("native-tpu" if service_backend == "jax" else "native-svc")
+            + ("-secure" if secure else ""),
             trace_dir=trace_dir,
             secure=secure,
+            pipeline=pipeline,
         )
     finally:
         service.stop()
@@ -311,6 +357,33 @@ def main() -> None:
         help="encrypted replica links (native arm only): measures the "
         "handshake + AEAD overhead at protocol level",
     )
+    parser.add_argument(
+        "--pipeline",
+        type=int,
+        default=None,
+        help="in-flight requests per nominal client (native arms; default "
+        "per-config PIPELINE table)",
+    )
+    parser.add_argument(
+        "--flush-us",
+        type=int,
+        default=0,
+        help="bounded verify accumulation window, microseconds (native arm: "
+        "per-daemon via network.json; native-tpu arm: at the service)",
+    )
+    parser.add_argument(
+        "--flush-items",
+        type=int,
+        default=0,
+        help="flush early once this many items are pending (0 = pad/window cap)",
+    )
+    parser.add_argument(
+        "--service-backend",
+        default="jax",
+        choices=["jax", "cpu", "native"],
+        help="native-tpu arm: the VerifierService backend (native = C++ "
+        "batch verifier, for occupancy runs without a chip)",
+    )
     args = parser.parse_args()
     if args.config is not None:
         if args.arm == "native-tpu":
@@ -320,6 +393,10 @@ def main() -> None:
                     requests=args.requests,
                     trace_dir=args.trace_dir,
                     secure=args.secure,
+                    pipeline=args.pipeline,
+                    flush_us=args.flush_us,
+                    flush_items=args.flush_items,
+                    service_backend=args.service_backend,
                 ).to_json()
             )
         elif args.arm == "native":
@@ -329,6 +406,9 @@ def main() -> None:
                     requests=args.requests,
                     trace_dir=args.trace_dir,
                     secure=args.secure,
+                    pipeline=args.pipeline,
+                    flush_us=args.flush_us,
+                    flush_items=args.flush_items,
                 ).to_json()
             )
         else:

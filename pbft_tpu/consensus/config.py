@@ -33,6 +33,13 @@ class ClusterConfig:
     watermark_window: int = 256
     checkpoint_interval: int = 16
     batch_pad: int = 64  # padded batch size fed to the TPU verifier
+    # Bounded verify accumulation: when verify_flush_us > 0 a replica
+    # holds its verify queue until verify_flush_items are pending
+    # (0 = batch_pad) or the oldest item has waited verify_flush_us —
+    # trading that much latency for a fatter batching window (more items
+    # per verifier launch). 0 = flush every event-loop pass.
+    verify_flush_us: int = 0
+    verify_flush_items: int = 0
     verifier: str = "cpu"  # "cpu" | "tpu"
     # Encrypted replica-replica links (signed-ephemeral DH + AEAD framing,
     # pbft_tpu/net/secure.py) — the reference's development_transport
@@ -59,6 +66,8 @@ class ClusterConfig:
                 "watermark_window": self.watermark_window,
                 "checkpoint_interval": self.checkpoint_interval,
                 "batch_pad": self.batch_pad,
+                "verify_flush_us": self.verify_flush_us,
+                "verify_flush_items": self.verify_flush_items,
                 "verifier": self.verifier,
                 "secure": self.secure,
                 "replicas": [dataclasses.asdict(r) for r in self.replicas],
@@ -74,6 +83,8 @@ class ClusterConfig:
             watermark_window=d.get("watermark_window", 256),
             checkpoint_interval=d.get("checkpoint_interval", 16),
             batch_pad=d.get("batch_pad", 64),
+            verify_flush_us=d.get("verify_flush_us", 0),
+            verify_flush_items=d.get("verify_flush_items", 0),
             verifier=d.get("verifier", "cpu"),
             secure=bool(d.get("secure", False)),
         )

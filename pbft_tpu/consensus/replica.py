@@ -228,6 +228,11 @@ class Replica:
         self._inbox.append(msg)
         return []
 
+    def pending_count(self) -> int:
+        """Queue depth without building the items — the server's bounded
+        accumulation window (config.verify_flush_us) polls this."""
+        return len(self._inbox)
+
     def pending_items(self) -> List[Tuple[bytes, bytes, bytes]]:
         """(pubkey32, digest32, sig64) per queued message, for the batch
         verifier (pbft_tpu.crypto.batch.verify_many or the TPU service)."""

@@ -59,6 +59,8 @@ class LocalCluster:
         trace_dir: Optional[str] = None,
         byzantine: Optional[List[int]] = None,
         secure: bool = False,
+        verify_flush_us: int = 0,
+        verify_flush_items: int = 0,
     ):
         self.trace_dir = trace_dir
         # Replica ids whose daemons corrupt every outgoing signature
@@ -80,6 +82,8 @@ class LocalCluster:
                 ],
                 verifier=verifier,
                 secure=secure,
+                verify_flush_us=verify_flush_us,
+                verify_flush_items=verify_flush_items,
             )
         self.config = config
         self.seeds = seeds

@@ -119,6 +119,14 @@ class AsyncReplicaServer:
         self.listen_port = 0
         self.batches_run = 0
         self.frames_in = 0
+        # Reply-dial pacing (mirrors core/net.cc start_reply_dial): the
+        # reply address is UNTRUSTED client input, so dials are
+        # deadline-bounded, capped in flight, and deduped per address —
+        # a burst of black-holed addresses must not accumulate tasks/FDs
+        # for the OS connect timeout. A dropped reply is re-fetched from
+        # the reply cache on client retransmission (PBFT §4.1).
+        self._reply_dial_sem = asyncio.Semaphore(32)
+        self._reply_addrs_in_flight: set = set()
         # Progress timer state (mirrors core/net.cc check_progress_timer).
         self._waiting_requests: Dict[Tuple[str, int], float] = {}
         self._timer_deadline: Optional[float] = None
@@ -302,9 +310,25 @@ class AsyncReplicaServer:
     async def _batch_pump(self) -> None:
         """Drain -> one batched verify (one XLA launch) -> emit, forever."""
         loop = asyncio.get_running_loop()
+        flush_s = self.config.verify_flush_us / 1e6
+        flush_target = self.config.verify_flush_items or self.config.batch_pad
         while not self._stopping:
             await self._batch_wakeup.wait()
             self._batch_wakeup.clear()
+            if flush_s > 0 and self.replica.pending_count():
+                # Bounded accumulation (config.verify_flush_us/_items):
+                # hold the queue until the item target or the deadline so
+                # one launch carries a whole window, not one wakeup's
+                # trickle. Socket readers keep appending meanwhile.
+                deadline = loop.time() + flush_s
+                while (
+                    not self._stopping
+                    and self.replica.pending_count() < flush_target
+                ):
+                    remaining = deadline - loop.time()
+                    if remaining <= 0:
+                        break
+                    await asyncio.sleep(min(remaining, flush_s / 8))
             items = self.replica.pending_items()
             if not items:
                 continue
@@ -384,7 +408,7 @@ class AsyncReplicaServer:
             # watch for it so the failure is loud (the C++ initiator
             # read-polls its dialed links for the same reason).
             asyncio.get_running_loop().create_task(
-                self._watch_plain_link(dest, reader, writer)
+                self._watch_link(dest, reader, writer)
             )
             return writer, None
         chan = secure.SecureChannel(
@@ -415,13 +439,27 @@ class AsyncReplicaServer:
             )
             writer.close()
             return None
+        # Secure links need the watcher too: a responder-side reject or
+        # close after the handshake must drop the cached link immediately,
+        # not linger until the next write fails (silently losing one send).
+        asyncio.get_running_loop().create_task(
+            self._watch_link(dest, reader, writer)
+        )
         return writer, chan
 
-    async def _watch_plain_link(self, dest: int, reader, writer) -> None:
-        """Surface reject frames arriving on a plaintext dialed link."""
+    async def _watch_link(self, dest: int, reader, writer) -> None:
+        """Watch a dialed link (plain or secure) for reject frames and
+        EOF. Dropping the cached link the moment the responder closes or
+        rejects means the next _send_to re-dials instead of writing into
+        a dead socket's kernel buffer (which would silently lose the
+        first post-failure send)."""
         try:
             while True:
-                obj = json.loads(await _read_frame(reader, timeout=3600.0))
+                raw = await _read_frame(reader, timeout=3600.0)
+                try:
+                    obj = json.loads(raw)
+                except ValueError:
+                    continue  # sealed frame on a secure link — not a reject
                 if isinstance(obj, dict) and obj.get("type") == "reject":
                     print(
                         f"replica {self.id}: peer {dest} rejected link: "
@@ -436,7 +474,7 @@ class AsyncReplicaServer:
             asyncio.IncompleteReadError,
             ValueError,
         ):
-            return  # EOF/garbage: the send path notices on its next write
+            pass  # EOF / dead or hour-idle link: drop and re-dial on demand
         writer.close()
         if (link := self._peer_links.get(dest)) and link[0] is writer:
             self._peer_links.pop(dest, None)
@@ -472,15 +510,31 @@ class AsyncReplicaServer:
                 self._peer_links.pop(dest, None)
 
     async def _dial_reply(self, client_addr: str, reply: ClientReply) -> None:
-        host, _, port = client_addr.rpartition(":")
-        reply = self._corrupt_sig(reply)
+        # One dial per address at a time — but a LATER reply to the same
+        # address is a distinct message (the client may already be on its
+        # next request), so wait for the slot rather than drop, bounded by
+        # the same ~6 s TTL the C++ reply backlog uses (core/net.cc).
+        deadline = time.monotonic() + 6.0
+        while client_addr in self._reply_addrs_in_flight:
+            if time.monotonic() >= deadline:
+                return  # expired: client retransmission re-fetches (§4.1)
+            await asyncio.sleep(0.05)
+        self._reply_addrs_in_flight.add(client_addr)
         try:
-            _, writer = await asyncio.open_connection(host, int(port))
-            writer.write(reply.canonical() + b"\n")
-            await writer.drain()
-            writer.close()
-        except (OSError, ValueError):
-            pass  # client gone
+            async with self._reply_dial_sem:
+                host, _, port = client_addr.rpartition(":")
+                reply = self._corrupt_sig(reply)
+                try:
+                    _, writer = await asyncio.wait_for(
+                        asyncio.open_connection(host, int(port)), timeout=3.0
+                    )
+                    writer.write(reply.canonical() + b"\n")
+                    await asyncio.wait_for(writer.drain(), timeout=3.0)
+                    writer.close()
+                except (OSError, ValueError, asyncio.TimeoutError):
+                    pass  # client gone / black-holed address
+        finally:
+            self._reply_addrs_in_flight.discard(client_addr)
 
     # -- request/progress timer (PBFT §4.4 liveness) -------------------------
 

@@ -25,6 +25,7 @@ from __future__ import annotations
 import socket
 import socketserver
 import threading
+import time
 from typing import Callable, List, Optional, Tuple
 
 Item = Tuple[bytes, bytes, bytes]
@@ -54,6 +55,16 @@ def cpu_backend(items: List[Item]) -> List[bool]:
     return [ref.verify(p, m, s) for p, m, s in items]
 
 
+def native_backend(items: List[Item]) -> List[bool]:
+    """The C++ batch verifier (core/ed25519.cc via ctypes): one fast host
+    verifier process serving every colocated daemon — the CPU-deployment
+    analogue of the jax backend, and the realistic control arm for
+    measuring coalesced window occupancy on a box without a chip."""
+    from .. import native
+
+    return [bool(v) for v in native.verify_batch(items)]
+
+
 class _Pending:
     __slots__ = ("items", "event", "verdicts", "error")
 
@@ -74,10 +85,31 @@ class VerifierService:
         unix_path: Optional[str] = None,
         backend: Callable[[List[Item]], List[bool]] | str = "jax",
         coalesce: bool = True,
+        flush_us: int = 0,
+        flush_items: int = 0,
+        trace_path: Optional[str] = None,
     ):
         if isinstance(backend, str):
-            backend = {"jax": jax_backend, "cpu": cpu_backend}[backend]
+            backend = {
+                "jax": jax_backend,
+                "cpu": cpu_backend,
+                "native": native_backend,
+            }[backend]
         self.backend = backend
+        # Bounded accumulation (the service-side analogue of the replicas'
+        # verify_flush_us): after the first request queues, the dispatcher
+        # waits until flush_items are pending (0 = MAX_WINDOW) or flush_us
+        # have passed, trading that much latency for a fatter merged
+        # window. 0 = dispatch as soon as the previous launch returns.
+        self._flush_s = flush_us / 1e6
+        self._flush_target = flush_items or self.MAX_WINDOW
+        # Per-dispatch JSONL trace ({"ev":"verify_batch","size":merged,..}):
+        # the honest occupancy measurement for the launch-cost model — the
+        # merged window IS the launch, where per-replica traces only see
+        # each daemon's share.
+        from ..utils.trace import Tracer
+
+        self._tracer = Tracer(open(trace_path, "a") if trace_path else None)
         self.batches = 0  # backend calls (XLA launches)
         self.requests = 0  # wire requests (>= batches when coalescing)
         self.items = 0
@@ -171,6 +203,20 @@ class VerifierService:
                     self._cond.wait(0.5)
                 if not self._running and not self._pending:
                     return
+                if self._flush_s > 0:
+                    # Bounded accumulation: hold the window open until the
+                    # item target or the deadline. _cond.wait releases the
+                    # lock, so handler threads keep enqueueing meanwhile.
+                    deadline = time.monotonic() + self._flush_s
+                    while (
+                        self._running
+                        and sum(len(p.items) for p in self._pending)
+                        < self._flush_target
+                    ):
+                        remaining = deadline - time.monotonic()
+                        if remaining <= 0:
+                            break
+                        self._cond.wait(remaining)
                 # Take whole requests up to MAX_WINDOW items (a single
                 # oversized request still goes through, alone).
                 window: List[_Pending] = []
@@ -208,6 +254,7 @@ class VerifierService:
         merged: List[Item] = []
         for p in window:
             merged.extend(p.items)
+        t0 = time.monotonic()
         try:
             verdicts = self._checked(self.backend, merged)
         except Exception:
@@ -215,6 +262,17 @@ class VerifierService:
             # signatures ("never a false reject"): retry each request
             # alone so only the actually-poisoned one errors out.
             verdicts = None
+        if self._tracer.enabled:
+            self._tracer.event(
+                "verify_batch",
+                replica="service",
+                size=len(merged),
+                requests=len(window),
+                rejected=(
+                    verdicts.count(False) if verdicts is not None else -1
+                ),
+                secs=round(time.monotonic() - t0, 6),
+            )
         with self._cond:
             self.batches += 1
             self.items += len(merged)
@@ -252,6 +310,15 @@ class VerifierService:
             self._thread.join(timeout=5)
         if self._dispatcher:
             self._dispatcher.join(timeout=5)
+        if self._tracer.sink is not None and (
+            self._dispatcher is None or not self._dispatcher.is_alive()
+        ):
+            # Only close once the dispatcher is provably done with it: a
+            # join timeout (e.g. a minutes-long first XLA compile still in
+            # flight) must leak the fd rather than turn that window's
+            # successful verifications into I/O errors mid-write.
+            self._tracer.sink.close()
+            self._tracer = type(self._tracer)()  # disabled from here on
 
 
 def main() -> None:
@@ -262,10 +329,34 @@ def main() -> None:
     parser.add_argument("--host", default="127.0.0.1")
     parser.add_argument("--port", type=int, default=7600)
     parser.add_argument("--unix", default=None)
-    parser.add_argument("--backend", default="jax", choices=["jax", "cpu"])
+    parser.add_argument(
+        "--backend", default="jax", choices=["jax", "cpu", "native"]
+    )
+    parser.add_argument(
+        "--flush-us",
+        type=int,
+        default=0,
+        help="bounded accumulation: hold each window up to this many "
+        "microseconds (0 = dispatch immediately)",
+    )
+    parser.add_argument(
+        "--flush-items",
+        type=int,
+        default=0,
+        help="...or until this many items are pending (0 = MAX_WINDOW)",
+    )
+    parser.add_argument(
+        "--trace", default=None, help="JSONL per-dispatch trace file"
+    )
     args = parser.parse_args()
     svc = VerifierService(
-        host=args.host, port=args.port, unix_path=args.unix, backend=args.backend
+        host=args.host,
+        port=args.port,
+        unix_path=args.unix,
+        backend=args.backend,
+        flush_us=args.flush_us,
+        flush_items=args.flush_items,
+        trace_path=args.trace,
     )
     print(f"verifier service on {svc.address} backend={args.backend}", flush=True)
     svc.server.serve_forever()

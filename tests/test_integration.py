@@ -375,3 +375,40 @@ def test_byzantine_primary_voted_out_over_secure_links():
             assert views and int(views[-1]) >= 1, "primary never voted out"
         finally:
             client.close()
+
+
+@pytest.mark.parametrize("impl", ["cxx", "py"])
+def test_bounded_accumulation_window_commits(impl):
+    """verify_flush_us holds each replica's verify queue briefly so one
+    launch carries a whole window (the f=1 occupancy lever). The latency
+    bound must hold: rounds still commit promptly, in both runtimes."""
+    with LocalCluster(
+        n=4, verifier="cpu", impl=impl, verify_flush_us=2000
+    ) as cluster:
+        assert cluster.config.verify_flush_us == 2000
+        client = PbftClient(cluster.config)
+        try:
+            for k in range(3):
+                req = client.request(f"windowed-{k}")
+                assert client.wait_result(req.timestamp, timeout=20) == "awesome!"
+        finally:
+            client.close()
+
+
+def test_verify_flush_config_round_trip():
+    """network.json carries the accumulation knob to both runtimes."""
+    from pbft_tpu.consensus.config import ClusterConfig, make_local_cluster
+
+    cfg, _ = make_local_cluster(4)
+    import dataclasses
+
+    cfg = dataclasses.replace(cfg, verify_flush_us=750, verify_flush_items=96)
+    back = ClusterConfig.from_json(cfg.to_json())
+    assert back.verify_flush_us == 750
+    assert back.verify_flush_items == 96
+    # Defaults stay zero (flush every pass) when the keys are absent.
+    legacy = ClusterConfig.from_json(
+        '{"replicas": %s}'
+        % cfg.to_json().split('"replicas": ', 1)[1].rstrip("}\n ")
+    )
+    assert legacy.verify_flush_us == 0 and legacy.verify_flush_items == 0

@@ -207,3 +207,111 @@ def test_window_respects_pad_ladder_cap():
     finally:
         gate.set()
         svc.stop()
+
+
+def test_bounded_accumulation_merges_a_trickle():
+    """flush_us holds the window open so requests arriving a few ms apart
+    merge into ONE backend launch instead of one launch each — the f=1
+    occupancy lever (BASELINE north star): the window trades bounded
+    latency for items-per-launch."""
+    calls = []
+
+    def backend(items):
+        calls.append(len(items))
+        return [p[0] == s[0] for p, m, s in items]
+
+    svc = VerifierService(backend=backend, flush_us=1_500_000).start()
+    try:
+        results = {}
+
+        def client(cid: int, delay: float):
+            time.sleep(delay)
+            results[cid] = _send_batch(svc.address, [_item(cid, True)])
+
+        threads = [
+            threading.Thread(target=client, args=(c, 0.05 * c))
+            for c in range(1, 4)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=15)
+        assert svc.requests == 3
+        # An instant backend would have dispatched each trickle item alone
+        # without the accumulation window.
+        assert svc.batches == 1, f"window did not hold: {calls}"
+        assert calls == [3]
+        for cid in range(1, 4):
+            assert results[cid] == [True]
+    finally:
+        svc.stop()
+
+
+def test_flush_items_short_circuits_the_deadline():
+    """Hitting the item target flushes immediately — the deadline is a
+    bound, not a tax on every window."""
+
+    def backend(items):
+        return [p[0] == s[0] for p, m, s in items]
+
+    # Deadline absurdly long: only the item target can explain a flush.
+    svc = VerifierService(
+        backend=backend, flush_us=60_000_000, flush_items=4
+    ).start()
+    try:
+        results = {}
+
+        def client(cid: int):
+            results[cid] = _send_batch(
+                svc.address, [_item(cid, True), _item(cid, False)]
+            )
+
+        t0 = time.monotonic()
+        threads = [threading.Thread(target=client, args=(c,)) for c in (1, 2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=20)
+        elapsed = time.monotonic() - t0
+        assert elapsed < 20, "flush_items target never fired"
+        assert results[1] == [True, False] and results[2] == [True, False]
+        assert svc.items == 4
+    finally:
+        svc.stop()
+
+
+def test_service_trace_records_merged_windows(tmp_path):
+    """The per-dispatch trace is the honest items-per-LAUNCH record for
+    the launch-cost model (per-replica traces only see each daemon's
+    share of a merged window)."""
+    import json
+
+    def backend(items):
+        return [p[0] == s[0] for p, m, s in items]
+
+    trace = tmp_path / "service.jsonl"
+    svc = VerifierService(
+        backend=backend, flush_us=1_000_000, trace_path=str(trace)
+    ).start()
+    try:
+        threads = [
+            threading.Thread(
+                target=lambda c=c: _send_batch(
+                    svc.address, [_item(c, True), _item(c, c % 2 == 0)]
+                )
+            )
+            for c in (2, 3)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=15)
+    finally:
+        svc.stop()
+    events = [json.loads(line) for line in trace.read_text().splitlines()]
+    batches = [e for e in events if e["ev"] == "verify_batch"]
+    assert batches, "no verify_batch events traced"
+    assert sum(e["size"] for e in batches) == 4
+    assert sum(e["requests"] for e in batches) == 2
+    assert sum(e["rejected"] for e in batches) == 1
+    assert all(e["secs"] >= 0 and e["replica"] == "service" for e in batches)
