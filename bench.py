@@ -56,8 +56,12 @@ import time
 import numpy as np
 
 _REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, _REPO)
+from pbft_tpu.utils.cache import host_keyed_cache_dir  # noqa: E402 (jax-free)
+
 os.environ.setdefault(
-    "JAX_COMPILATION_CACHE_DIR", os.path.join(_REPO, ".jax_cache")
+    "JAX_COMPILATION_CACHE_DIR",
+    host_keyed_cache_dir(os.path.join(_REPO, ".jax_cache")),
 )
 
 _METRIC = "ed25519_sig_verifies_per_sec"

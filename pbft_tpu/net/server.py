@@ -522,6 +522,12 @@ class AsyncReplicaServer:
         self._reply_addrs_in_flight.add(client_addr)
         try:
             async with self._reply_dial_sem:
+                if time.monotonic() >= deadline:
+                    # Expired while queued for a dial slot (e.g. behind a
+                    # burst of black-holed addresses): a reply this stale
+                    # is the retransmission path's job now — dialing it
+                    # would keep the backlog alive long past the TTL.
+                    return
                 host, _, port = client_addr.rpartition(":")
                 reply = self._corrupt_sig(reply)
                 try:

@@ -263,8 +263,12 @@ class VerifierService:
             # alone so only the actually-poisoned one errors out.
             verdicts = None
         if self._tracer.enabled:
+            # A failed merged launch is NOT a verify_batch event: the
+            # launch-cost model reads verify_batch sizes as items-per-
+            # launch, and counting the failed merge (plus not counting
+            # its per-request retries below) would overstate occupancy.
             self._tracer.event(
-                "verify_batch",
+                "verify_batch" if verdicts is not None else "verify_window_failed",
                 replica="service",
                 size=len(merged),
                 requests=len(window),
@@ -278,10 +282,24 @@ class VerifierService:
             self.items += len(merged)
         if verdicts is None:
             for p in window:
+                t1 = time.monotonic()
                 try:
                     p.verdicts = self._checked(self.backend, p.items)
                 except Exception as e:  # noqa: BLE001 - handed to submitter
                     p.error = e
+                if self._tracer.enabled:
+                    self._tracer.event(
+                        "verify_batch",
+                        replica="service",
+                        size=len(p.items),
+                        requests=1,
+                        rejected=(
+                            p.verdicts.count(False)
+                            if p.verdicts is not None
+                            else -1
+                        ),
+                        secs=round(time.monotonic() - t1, 6),
+                    )
                 p.event.set()
             return
         off = 0

@@ -1,0 +1,41 @@
+"""Host-feature-keyed persistent compile cache location.
+
+XLA:CPU AOT cache entries embed the compiling machine's CPU features;
+loading an entry compiled on a better-featured host only WARNS at load
+time but can SIGILL at execution time. The multichip dryrun is the one
+gate that must never flake, and its workspace (including `.jax_cache/`)
+can move between hosts — so the cache directory is keyed by the host's
+machine type + CPU feature flags: a foreign cache lands under a
+different key and is simply never read. The cost of a feature mismatch
+is a cold recompile, never a crash.
+
+This module must stay importable without touching jax (bench.py and
+__graft_entry__.py compute the cache path before backend init).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import platform
+
+
+def host_cache_key() -> str:
+    """12-hex digest of this host's machine type + CPU feature flags."""
+    flags = ""
+    try:
+        with open("/proc/cpuinfo") as fh:
+            for line in fh:
+                if line.split(":")[0].strip() in ("flags", "Features"):
+                    flags = line.split(":", 1)[1].strip()
+                    break
+    except OSError:
+        pass  # non-Linux: machine type alone still separates real moves
+    return hashlib.blake2b(
+        f"{platform.machine()}|{flags}".encode(), digest_size=6
+    ).hexdigest()
+
+
+def host_keyed_cache_dir(root: str) -> str:
+    """<root>/<host_cache_key()>, e.g. .jax_cache/a1b2c3d4e5f6."""
+    return os.path.join(root, host_cache_key())

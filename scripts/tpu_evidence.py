@@ -46,8 +46,13 @@ def run_step(name: str, cmd, env_extra=None, timeout=900, out_json=None):
     from the last {...} stdout line when out_json is set."""
     import bench
 
+    from pbft_tpu.utils.cache import host_keyed_cache_dir
+
     env = dict(os.environ)
-    env.setdefault("JAX_COMPILATION_CACHE_DIR", os.path.join(REPO, ".jax_cache"))
+    env.setdefault(
+        "JAX_COMPILATION_CACHE_DIR",
+        host_keyed_cache_dir(os.path.join(REPO, ".jax_cache")),
+    )
     env.update(env_extra or {})
     log(f"step {name}: {' '.join(cmd)}")
     try:

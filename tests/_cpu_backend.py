@@ -32,9 +32,15 @@ def force_cpu(n_devices: int = 8, compile_cache: bool = True) -> None:
     if compile_cache:
         # Persistent compilation cache: the crypto kernels are
         # compile-heavy; caching cuts repeat runs from minutes to seconds.
+        # Host-feature-keyed (pbft_tpu.utils.cache): entries carried over
+        # from a different machine are never read (SIGILL hazard).
+        from pbft_tpu.utils.cache import host_keyed_cache_dir
+
         jax.config.update(
             "jax_compilation_cache_dir",
-            os.path.join(os.path.dirname(__file__), "..", ".jax_cache"),
+            host_keyed_cache_dir(
+                os.path.join(os.path.dirname(__file__), "..", ".jax_cache")
+            ),
         )
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)

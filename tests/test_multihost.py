@@ -21,6 +21,8 @@ pytestmark = pytest.mark.slow  # two cold kernel compiles in subprocesses
 
 _WORKER = Path(__file__).parent / "multihost_worker.py"
 _REPO = str(Path(__file__).resolve().parent.parent)
+sys.path.insert(0, _REPO)
+from pbft_tpu.utils.cache import host_keyed_cache_dir  # noqa: E402
 
 
 def _free_port() -> int:
@@ -35,7 +37,9 @@ def test_two_process_quorum_certify_agrees(tmp_path):
         os.environ,
         PYTHONPATH=_REPO,
         JAX_PLATFORMS="cpu",
-        JAX_COMPILATION_CACHE_DIR=str(Path(_REPO) / ".jax_cache"),
+        JAX_COMPILATION_CACHE_DIR=host_keyed_cache_dir(
+            str(Path(_REPO) / ".jax_cache")
+        ),
     )
     # stdout/stderr go to FILES, not pipes: a worker spewing more than a
     # pipe buffer of JAX warnings before the gloo barrier would otherwise
