@@ -357,6 +357,23 @@ def main() -> None:
             ):
                 break
     fallback_reason = "tpu bench never completed; CPU fallback"
+    # If the round-long watcher (scripts/tpu_watch.py) already captured an
+    # on-chip kernel number during a tunnel window, point the artifact's
+    # note at it: the fallback VALUE stays the honest live measurement,
+    # but the reader should know driver-visible on-chip evidence exists.
+    tag = os.environ.get("PBFT_ROUND_TAG", "r5")  # tpu_watch.py --tag
+    rel = os.path.join("benchmarks", f"tpu_{tag}_kernel_xla.json")
+    if os.path.exists(os.path.join(_REPO, rel)):
+        try:
+            with open(os.path.join(_REPO, rel)) as fh:
+                cap = json.load(fh)
+            if isinstance(cap, dict):
+                fallback_reason += (
+                    f"; same-round on-chip capture exists: "
+                    f"{cap.get('value')} {cap.get('unit', 'sig/s')} ({rel})"
+                )
+        except (OSError, ValueError):
+            pass
     _log(fallback_reason)
     if _native_fallback(target_secs, fallback_reason):
         return

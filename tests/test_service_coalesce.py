@@ -87,10 +87,15 @@ def test_uncoalesced_mode_still_works():
         svc.stop()
 
 
-def test_poison_batch_only_fails_its_own_connection():
+def test_poison_batch_only_fails_its_own_connection(tmp_path):
     """A backend failure on a merged launch must not false-reject other
     clients' honest signatures: the window is retried per-request and only
-    the poisoned connection errors out."""
+    the poisoned connection errors out. The trace must stay honest too:
+    the failed merge is verify_window_failed (NOT verify_batch, whose
+    sizes the launch-cost model reads as items-per-launch) and the
+    retries are traced as singleton launches."""
+    import json
+
     gate = threading.Event()
     first = threading.Event()
 
@@ -103,7 +108,8 @@ def test_poison_batch_only_fails_its_own_connection():
             raise RuntimeError("poison")
         return [p[0] == s[0] for p, m, s in items]
 
-    svc = VerifierService(backend=backend).start()
+    trace = tmp_path / "service.jsonl"
+    svc = VerifierService(backend=backend, trace_path=str(trace)).start()
     try:
         results = {}
 
@@ -134,6 +140,13 @@ def test_poison_batch_only_fails_its_own_connection():
     finally:
         gate.set()
         svc.stop()
+    events = [json.loads(line) for line in trace.read_text().splitlines()]
+    vb = [e for e in events if e["ev"] == "verify_batch"]
+    failed = [e for e in events if e["ev"] == "verify_window_failed"]
+    assert len(failed) == 1 and failed[0]["size"] == 3, failed
+    # 1 clean launch (the held first request) + 3 singleton retries.
+    assert sum(e["size"] for e in vb) == 4, vb
+    assert all(e["requests"] == 1 for e in vb if e["size"] == 1), vb
 
 
 def test_wrong_length_verdicts_fail_loudly():
@@ -315,3 +328,4 @@ def test_service_trace_records_merged_windows(tmp_path):
     assert sum(e["requests"] for e in batches) == 2
     assert sum(e["rejected"] for e in batches) == 1
     assert all(e["secs"] >= 0 and e["replica"] == "service" for e in batches)
+
