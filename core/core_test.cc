@@ -3,6 +3,9 @@
 // the pieces a pure-C++ build must guarantee on its own: crypto known
 // answers, canonical JSON, and a full in-process 4-replica consensus round
 // including a view change.
+#include <sys/socket.h>
+#include <unistd.h>
+
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
@@ -407,6 +410,54 @@ void test_secure_channel_native() {
   CHECK(d.error().find("plaintext peer rejected") != std::string::npos);
 }
 
+void test_remote_verifier_async() {
+  // Drive the async verifier protocol against a socketpair standing in
+  // for the service: request framing, partial-verdict reads, and the
+  // mid-batch-EOF failure signal the event loop's CPU safety net keys on.
+  int sv[2];
+  CHECK(::socketpair(AF_UNIX, SOCK_STREAM, 0, sv) == 0);
+  pbft::RemoteVerifier rv("/nonexistent-but-unused");
+  rv.adopt_fd_for_test(sv[0]);
+
+  std::vector<pbft::VerifyItem> items(3);
+  for (int i = 0; i < 3; ++i) {
+    std::memset(items[i].pub, i + 1, 32);
+    std::memset(items[i].msg, i + 9, 32);
+    std::memset(items[i].sig, i + 17, 64);
+  }
+  CHECK(rv.begin_batch(items));
+  CHECK(rv.async_fd() == sv[0]);
+  // Duplicate dispatch while in flight is refused.
+  CHECK(!rv.begin_batch(items));
+
+  // Service side: whole request arrives framed as u32be count + 128 B/item.
+  uint8_t req[4 + 3 * 128];
+  CHECK(read(sv[1], req, sizeof(req)) == (ssize_t)sizeof(req));
+  CHECK(req[3] == 3 && req[0] == 0);
+  CHECK(req[4] == 1 && req[4 + 128] == 2);  // first pub byte per item
+
+  std::vector<uint8_t> verdicts;
+  bool failed = true;
+  // Nothing written yet: poll_result must report "still in flight".
+  CHECK(!rv.poll_result(&verdicts, &failed));
+  // Partial verdicts: still in flight.
+  uint8_t part1[1] = {1};
+  CHECK(write(sv[1], part1, 1) == 1);
+  CHECK(!rv.poll_result(&verdicts, &failed));
+  uint8_t part2[2] = {0, 1};
+  CHECK(write(sv[1], part2, 2) == 2);
+  CHECK(rv.poll_result(&verdicts, &failed));
+  CHECK(!failed);
+  CHECK(verdicts == (std::vector<uint8_t>{1, 0, 1}));
+
+  // Second batch: EOF mid-flight flags failure (fallback's cue).
+  CHECK(rv.begin_batch(items));
+  CHECK(read(sv[1], req, sizeof(req)) == (ssize_t)sizeof(req));
+  ::close(sv[1]);
+  CHECK(rv.poll_result(&verdicts, &failed));
+  CHECK(failed);
+}
+
 }  // namespace
 
 int main() {
@@ -419,6 +470,7 @@ int main() {
   test_view_change_native();
   test_stable_digest_majority_native();
   test_state_transfer_native();
+  test_remote_verifier_async();
   if (g_failures) {
     std::fprintf(stderr, "%d failure(s)\n", g_failures);
     return 1;

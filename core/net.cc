@@ -161,10 +161,18 @@ void ReplicaServer::poll_once(int timeout_ms) {
   // Outbound links are read-polled too: handshake replies and reject
   // frames arrive on the dialed connection.
   for (auto& [_, c] : peers_) add_conn(c.get());
+  // Async verifier launch in flight: poll its socket alongside the
+  // peers — verdict readiness is just another I/O event.
+  const size_t conn_pfds_end = pfds.size();
+  size_t verifier_pfd = 0;  // 0 = not polled (slot 0 is the listener)
+  if (verify_inflight_ && verifier_->async_fd() >= 0) {
+    verifier_pfd = pfds.size();
+    pfds.push_back({verifier_->async_fd(), POLLIN, 0});
+  }
   int n = ::poll(pfds.data(), pfds.size(), timeout_ms);
   if (n < 0) return;
   if (pfds[0].revents & POLLIN) accept_ready();
-  for (size_t i = 1; i < pfds.size(); ++i) {
+  for (size_t i = 1; i < conn_pfds_end; ++i) {
     Conn* c = order[i - 1];
     if (c->closed) continue;
     if (c->connecting) {
@@ -174,8 +182,14 @@ void ReplicaServer::poll_once(int timeout_ms) {
     if (pfds[i].revents & (POLLIN | POLLHUP | POLLERR)) handle_readable(*c);
     if ((pfds[i].revents & POLLOUT) && !c->closed) flush(*c);
   }
+  if (verifier_pfd != 0 &&
+      (pfds[verifier_pfd].revents & (POLLIN | POLLHUP | POLLERR))) {
+    finish_verify_async();
+  }
   // The batching window: everything that arrived this iteration verifies
-  // as one batch (one XLA launch on the TPU backend).
+  // as one batch (one XLA launch on the TPU backend). With an async
+  // verifier this immediately dispatches the window that accumulated
+  // during the launch that just completed.
   run_verify_batch();
   pump_reply_backlog();  // launch queued reply dials as slots free
   check_progress_timer();
@@ -493,6 +507,7 @@ void ReplicaServer::trace_view_change(int backoff) {
 }
 
 void ReplicaServer::run_verify_batch() {
+  if (verify_inflight_) return;  // accumulate; finish_verify_async delivers
   size_t pending = replica_->pending_count();
   if (pending == 0) {
     verify_window_open_ = false;
@@ -517,18 +532,59 @@ void ReplicaServer::run_verify_batch() {
     verify_window_open_ = false;
   }
   auto items = replica_->pending_items();
-  ++batches_run_;
+  // Async first (RemoteVerifier): ship the batch and keep the loop
+  // draining sockets — the round-trip is where the next window's
+  // occupancy accumulates. Falls through to the blocking path when the
+  // backend is sync-only (CPU), the batch exceeds the async write
+  // budget, or the transport is down.
+  if (verifier_->begin_batch(items)) {
+    verify_inflight_ = true;
+    inflight_items_ = std::move(items);
+    inflight_start_ = std::chrono::steady_clock::now();
+    return;
+  }
   auto t0 = std::chrono::steady_clock::now();
-  auto verdicts = verifier_->verify_batch(items);
+  deliver_verified(items.size(), t0, verifier_->verify_batch(items));
+}
+
+void ReplicaServer::deliver_verified(size_t n_items,
+                                     std::chrono::steady_clock::time_point t0,
+                                     std::vector<uint8_t> verdicts) {
+  ++batches_run_;
   if (trace_fp_) {
     int64_t rejected = 0;
     for (uint8_t v : verdicts) rejected += v ? 0 : 1;
     trace_batch(
-        (int64_t)items.size(), rejected,
+        (int64_t)n_items, rejected,
         std::chrono::duration<double>(std::chrono::steady_clock::now() - t0)
             .count());
   }
   emit(replica_->deliver_verdicts(verdicts));
+}
+
+void ReplicaServer::finish_verify_async() {
+  std::vector<uint8_t> verdicts;
+  bool failed = false;
+  if (!verifier_->poll_result(&verdicts, &failed)) return;  // partial read
+  if (failed) {
+    // Service died mid-launch: a verifier outage degrades throughput,
+    // never safety/liveness — re-verify this batch in-process.
+    CpuVerifier safety_net;
+    verdicts = safety_net.verify_batch(inflight_items_);
+  }
+  auto dispatched_at = inflight_start_;
+  size_t n_items = inflight_items_.size();
+  verify_inflight_ = false;
+  inflight_items_.clear();
+  deliver_verified(n_items, dispatched_at, std::move(verdicts));
+  // Items that queued DURING the launch have already waited up to the
+  // round-trip: backdate the next flush window to the dispatch time so
+  // the accumulation hold and the launch overlap instead of serializing
+  // (an item's extra hold stays <= max(flush_us, launch RTT)).
+  if (cfg_.verify_flush_us > 0 && replica_->pending_count() > 0) {
+    verify_window_open_ = true;
+    verify_window_start_ = dispatched_at;
+  }
 }
 
 void ReplicaServer::emit(Actions&& actions) {

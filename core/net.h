@@ -122,6 +122,15 @@ class ReplicaServer {
   bool fail_conn(Conn& c, const std::string& reason);
   void flush(Conn& c);
   void run_verify_batch();
+  // Drain verdict bytes from an async (RemoteVerifier) launch; on
+  // completion deliver + emit, on transport failure re-verify the
+  // in-flight batch via the CPU safety net.
+  void finish_verify_async();
+  // Shared verdict accounting for the sync and async paths: counter,
+  // trace (duration measured from t0), deliver + emit.
+  void deliver_verified(size_t n_items,
+                        std::chrono::steady_clock::time_point t0,
+                        std::vector<uint8_t> verdicts);
   void emit(Actions&& actions);
   void send_to(int64_t dest, const Message& m);
   void dial_reply(const std::string& client_addr, const ClientReply& reply);
@@ -200,6 +209,12 @@ class ReplicaServer {
   // promised latency bound.
   bool verify_window_open_ = false;
   std::chrono::steady_clock::time_point verify_window_start_{};
+  // Async verify launch in flight (RemoteVerifier): the event loop keeps
+  // draining peers while the service runs the launch — the next window
+  // accumulates during the round-trip instead of the loop stalling on it.
+  bool verify_inflight_ = false;
+  std::vector<VerifyItem> inflight_items_;
+  std::chrono::steady_clock::time_point inflight_start_{};
 };
 
 // "host:port" -> connected TCP fd (blocking connect), or -1.

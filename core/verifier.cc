@@ -31,6 +31,12 @@ RemoteVerifier::~RemoteVerifier() {
 
 bool RemoteVerifier::ensure_connected() {
   if (fd_ >= 0) return true;
+  // Best-effort: a roomier send buffer widens the async write budget
+  // (the kernel clamps to wmem_max without privileges; harmless if so).
+  auto grow_sndbuf = [](int fd) {
+    int want = 1 << 20;
+    ::setsockopt(fd, SOL_SOCKET, SO_SNDBUF, &want, sizeof(want));
+  };
   if (!target_.empty() && target_[0] == '/') {
     fd_ = ::socket(AF_UNIX, SOCK_STREAM, 0);
     if (fd_ < 0) return false;
@@ -42,9 +48,11 @@ bool RemoteVerifier::ensure_connected() {
       fd_ = -1;
       return false;
     }
+    grow_sndbuf(fd_);
     return true;
   }
   fd_ = dial_tcp(target_);  // shared TCP dialer (net.cc)
+  if (fd_ >= 0) grow_sndbuf(fd_);
   return fd_ >= 0;
 }
 
@@ -68,30 +76,102 @@ static bool read_all(int fd, uint8_t* data, size_t n) {
   return true;
 }
 
-std::vector<uint8_t> RemoteVerifier::verify_batch(
+static std::vector<uint8_t> encode_request(
     const std::vector<VerifyItem>& items) {
-  if (items.empty()) return {};
-  if (!ensure_connected()) return fallback_.verify_batch(items);
   const uint32_t n = (uint32_t)items.size();
-  std::vector<uint8_t> buf(4 + n * 128);
+  std::vector<uint8_t> buf(4 + (size_t)n * 128);
   buf[0] = (uint8_t)(n >> 24);
   buf[1] = (uint8_t)(n >> 16);
   buf[2] = (uint8_t)(n >> 8);
   buf[3] = (uint8_t)n;
   for (uint32_t i = 0; i < n; ++i) {
-    uint8_t* p = buf.data() + 4 + i * 128;
+    uint8_t* p = buf.data() + 4 + (size_t)i * 128;
     std::memcpy(p, items[i].pub, 32);
     std::memcpy(p + 32, items[i].msg, 32);
     std::memcpy(p + 64, items[i].sig, 64);
   }
-  std::vector<uint8_t> out(n);
+  return buf;
+}
+
+std::vector<uint8_t> RemoteVerifier::verify_batch(
+    const std::vector<VerifyItem>& items) {
+  if (items.empty()) return {};
+  // A sync call with a batch still in flight would desync the
+  // one-reply-per-request pairing on the connection: drop the link and
+  // let both batches go through the fallback (callers never mix modes,
+  // so this is belt-and-braces).
+  if (inflight_) {
+    ::close(fd_);
+    fd_ = -1;
+    inflight_ = false;
+  }
+  if (!ensure_connected()) return fallback_.verify_batch(items);
+  auto buf = encode_request(items);
+  std::vector<uint8_t> out(items.size());
   if (!write_all(fd_, buf.data(), buf.size()) ||
-      !read_all(fd_, out.data(), n)) {
+      !read_all(fd_, out.data(), out.size())) {
     ::close(fd_);
     fd_ = -1;
     return fallback_.verify_batch(items);
   }
   return out;
+}
+
+// Largest batch dispatched asynchronously: the request must fit the
+// socket send buffer so the (blocking) write below cannot stall the
+// event loop while the service is busy inside its own launch. Linux's
+// default wmem is ~208 KiB; 1,500 items encode to 188 KiB. Bigger
+// windows simply take the caller's synchronous path — the pre-async
+// behavior, and rare (the service's own merge cap is 4096).
+static constexpr size_t kMaxAsyncItems = 1500;
+
+bool RemoteVerifier::begin_batch(const std::vector<VerifyItem>& items) {
+  if (items.empty() || items.size() > kMaxAsyncItems || inflight_) {
+    return false;
+  }
+  if (!ensure_connected()) return false;
+  auto buf = encode_request(items);
+  if (!write_all(fd_, buf.data(), buf.size())) {
+    ::close(fd_);
+    fd_ = -1;
+    return false;
+  }
+  inflight_ = true;
+  expect_ = items.size();
+  resp_.clear();
+  return true;
+}
+
+bool RemoteVerifier::poll_result(std::vector<uint8_t>* out, bool* failed) {
+  *failed = false;
+  if (!inflight_) {
+    *failed = true;
+    return true;
+  }
+  while (resp_.size() < expect_) {
+    uint8_t chunk[4096];
+    size_t want = expect_ - resp_.size();
+    ssize_t r = ::recv(fd_, chunk, want < sizeof(chunk) ? want : sizeof(chunk),
+                       MSG_DONTWAIT);
+    if (r > 0) {
+      resp_.insert(resp_.end(), chunk, chunk + r);
+      continue;
+    }
+    if (r < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+      return false;  // more verdicts still on the wire; poll again
+    }
+    // EOF or error mid-batch: the service died — hand the batch back to
+    // the caller's fallback.
+    ::close(fd_);
+    fd_ = -1;
+    inflight_ = false;
+    *failed = true;
+    return true;
+  }
+  inflight_ = false;
+  *out = std::move(resp_);
+  resp_ = {};
+  return true;
 }
 
 }  // namespace pbft

@@ -28,6 +28,28 @@ class Verifier {
   virtual ~Verifier() = default;
   virtual std::vector<uint8_t> verify_batch(
       const std::vector<VerifyItem>& items) = 0;
+
+  // Asynchronous protocol, for backends whose launch crosses a socket
+  // (RemoteVerifier): the event loop must NOT stall for the round-trip —
+  // it keeps draining peers while the launch runs, which is where the
+  // batching window's occupancy comes from. Sync-only backends return
+  // -1 from async_fd() and the caller uses verify_batch.
+  virtual int async_fd() const { return -1; }
+  // Send one batch without waiting for the verdicts. False = transport
+  // unavailable (caller should verify this batch synchronously instead).
+  virtual bool begin_batch(const std::vector<VerifyItem>& items) {
+    (void)items;
+    return false;
+  }
+  // Drain whatever verdict bytes are readable (call when poll() reports
+  // async_fd readable). Returns true once the batch completed with *out
+  // filled; on transport failure returns true with *failed set (the
+  // caller re-verifies that batch via its fallback).
+  virtual bool poll_result(std::vector<uint8_t>* out, bool* failed) {
+    (void)out;
+    *failed = true;
+    return true;
+  }
 };
 
 class CpuVerifier : public Verifier {
@@ -44,11 +66,22 @@ class RemoteVerifier : public Verifier {
   std::vector<uint8_t> verify_batch(
       const std::vector<VerifyItem>& items) override;
 
+  int async_fd() const override { return inflight_ ? fd_ : -1; }
+  bool begin_batch(const std::vector<VerifyItem>& items) override;
+  bool poll_result(std::vector<uint8_t>* out, bool* failed) override;
+  // Test hook: adopt an already-connected fd (e.g. a socketpair end).
+  void adopt_fd_for_test(int fd) { fd_ = fd; }
+
  private:
   bool ensure_connected();
   std::string target_;
   int fd_ = -1;
   CpuVerifier fallback_;
+  // One batch in flight at a time (the service pairs one reply per
+  // request on the connection, in order).
+  bool inflight_ = false;
+  std::vector<uint8_t> resp_;  // verdict bytes received so far
+  size_t expect_ = 0;
 };
 
 }  // namespace pbft

@@ -1,0 +1,90 @@
+#!/usr/bin/env python
+"""Occupancy sweep: pipeline depth x flush window -> items/launch.
+
+Runs the f=1 firehose config through the coalescing VerifierService
+(native C++ backend — no chip needed; occupancy is a property of the
+windowing, not the verifier) across a grid of in-flight depths and
+bounded-accumulation windows, and prints one JSON line per cell with the
+measured merged-window occupancy and the launch-cost-model projection at
+on-host launch cost. This is the committed evidence behind BASELINE.md's
+claim that the f=1 batching window scales with load and the knob — not a
+single lucky run.
+
+Usage: python scripts/window_sweep.py [--out benchmarks/window_sweep.jsonl]
+       [--pipelines 8,16,32,64] [--flushes 0,1000,2000] [--requests 192]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pathlib
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+
+from trace_report import expand_trace_args  # noqa: E402
+from launch_cost_model import window_stats  # noqa: E402
+
+
+def run_cell(pipeline: int, flush_us: int, requests: int, kernel_rate: float):
+    from pbft_tpu.bench.harness import run_native_tpu_config
+
+    with tempfile.TemporaryDirectory(prefix="sweep-") as td:
+        trace_dir = os.path.join(td, "traces")
+        os.makedirs(trace_dir)
+        res = run_native_tpu_config(
+            1,  # firehose f=1
+            requests=requests,
+            trace_dir=trace_dir,
+            pipeline=pipeline,
+            flush_us=flush_us,
+            service_backend="native",
+        )
+        files = expand_trace_args([f"{trace_dir}-service"])
+        win = window_stats(files)
+    per_item = 1.0 / kernel_rate + 100e-6 / win["items_per_launch"]
+    return {
+        "config": "firehose f=1",
+        "pipeline": pipeline,
+        "flush_us": flush_us,
+        "requests": res.requests,
+        "rounds_per_sec": res.rounds_per_sec,
+        "items_per_launch": round(win["items_per_launch"], 2),
+        "launches": win["launches"],
+        "projected_100us_per_sec": round(1.0 / per_item, 1),
+    }
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--out", default=None)
+    parser.add_argument("--pipelines", default="8,16,32,64")
+    parser.add_argument("--flushes", default="0,1000,2000")
+    parser.add_argument("--requests", type=int, default=192)
+    parser.add_argument(
+        "--kernel",
+        default=os.path.join(REPO, "benchmarks", "tpu_r3_kernel_builder.json"),
+        help="committed kernel measurement for the projection column",
+    )
+    args = parser.parse_args()
+    kernel_rate = float(json.loads(pathlib.Path(args.kernel).read_text())["value"])
+
+    rows = []
+    for pipeline in [int(x) for x in args.pipelines.split(",")]:
+        for flush_us in [int(x) for x in args.flushes.split(",")]:
+            row = run_cell(pipeline, flush_us, args.requests, kernel_rate)
+            print(json.dumps(row), flush=True)
+            rows.append(row)
+    if args.out:
+        with open(args.out, "w") as fh:
+            for row in rows:
+                fh.write(json.dumps(row) + "\n")
+
+
+if __name__ == "__main__":
+    main()

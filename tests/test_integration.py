@@ -412,3 +412,24 @@ def test_verify_flush_config_round_trip():
         % cfg.to_json().split('"replicas": ', 1)[1].rstrip("}\n ")
     )
     assert legacy.verify_flush_us == 0 and legacy.verify_flush_items == 0
+
+
+def test_view_change_fires_under_accumulation_window():
+    """Liveness interaction: the bounded accumulation window delays
+    verification by up to T µs — it must not starve the §4.4 request
+    timer. Kill the primary with verify_flush_us set; the view change's
+    own messages ride through held windows and still elect view 1."""
+    with LocalCluster(
+        n=4, verifier="cpu", vc_timeout_ms=500, verify_flush_us=3000
+    ) as cluster:
+        client = PbftClient(cluster.config)
+        try:
+            req = client.request("warmup")
+            assert client.wait_result(req.timestamp, timeout=15) == "awesome!"
+            cluster.kill(0)
+            result = client.request_with_retry(
+                "post-crash-windowed", timeout=30, retry_every=1.0
+            )
+            assert result == "awesome!"
+        finally:
+            client.close()
