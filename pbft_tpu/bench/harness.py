@@ -289,6 +289,7 @@ def run_native_tpu_config(
     flush_us: int = 0,
     flush_items: int = 0,
     service_backend: str = "jax",
+    service_inflight: int = 1,
 ) -> BenchResult:
     """run_native_config against one coalescing VerifierService shared by
     every daemon — the TPU deployment shape (N replicas on one host, one
@@ -315,6 +316,7 @@ def run_native_tpu_config(
         flush_us=flush_us,
         flush_items=flush_items,
         trace_path=service_trace,
+        inflight=service_inflight,
     ).start()
     try:
         return run_native_config(
@@ -384,6 +386,13 @@ def main() -> None:
         help="native-tpu arm: the VerifierService backend (native = C++ "
         "batch verifier, for occupancy runs without a chip)",
     )
+    parser.add_argument(
+        "--service-inflight",
+        type=int,
+        default=1,
+        help="native-tpu arm: overlapped service launches (ship window "
+        "N+1 while N executes; 1 = serial)",
+    )
     args = parser.parse_args()
     if args.config is not None:
         if args.arm == "native-tpu":
@@ -397,6 +406,7 @@ def main() -> None:
                     flush_us=args.flush_us,
                     flush_items=args.flush_items,
                     service_backend=args.service_backend,
+                    service_inflight=args.service_inflight,
                 ).to_json()
             )
         elif args.arm == "native":

@@ -88,6 +88,7 @@ class VerifierService:
         flush_us: int = 0,
         flush_items: int = 0,
         trace_path: Optional[str] = None,
+        inflight: int = 1,
     ):
         if isinstance(backend, str):
             backend = {
@@ -103,6 +104,15 @@ class VerifierService:
         # window. 0 = dispatch as soon as the previous launch returns.
         self._flush_s = flush_us / 1e6
         self._flush_target = flush_items or self.MAX_WINDOW
+        # Overlapped launches: with inflight > 1 the dispatcher ships
+        # window N+1 while N is still executing, hiding host-side launch
+        # overhead behind device compute (XLA serializes execution per
+        # device; the dispatch/transfer cost is what overlaps). Default 1
+        # preserves the "window = what queued during the previous launch"
+        # dynamic; raising it trades window size for launch concurrency.
+        self._inflight = max(1, inflight)
+        self._inflight_sem = threading.Semaphore(self._inflight)
+        self._launch_threads: List[threading.Thread] = []
         # Per-dispatch JSONL trace ({"ev":"verify_batch","size":merged,..}):
         # the honest occupancy measurement for the launch-cost model — the
         # merged window IS the launch, where per-replica traces only see
@@ -227,16 +237,35 @@ class VerifierService:
                         break
                     size += nxt
                     window.append(self._pending.pop(0))
-            try:
-                self._dispatch_window(window)
-            except Exception as e:  # noqa: BLE001 - never strand a handler
-                # Any dispatcher bug outside the backend guard must still
-                # wake every waiting connection with an error rather than
-                # leaving clients hung mid-read.
-                for p in window:
-                    if not p.event.is_set():
-                        p.error = e
-                        p.event.set()
+            self._inflight_sem.acquire()
+            if self._inflight == 1:
+                self._dispatch_guarded(window)
+            else:
+                # Overlapped mode: the launch runs on its own thread while
+                # the dispatcher loops back to accumulate the next window.
+                t = threading.Thread(
+                    target=self._dispatch_guarded, args=(window,), daemon=True
+                )
+                with self._cond:  # stop() reads this list concurrently
+                    self._launch_threads = [
+                        x for x in self._launch_threads if x.is_alive()
+                    ]
+                    self._launch_threads.append(t)
+                t.start()
+
+    def _dispatch_guarded(self, window: List[_Pending]) -> None:
+        try:
+            self._dispatch_window(window)
+        except Exception as e:  # noqa: BLE001 - never strand a handler
+            # Any dispatcher bug outside the backend guard must still
+            # wake every waiting connection with an error rather than
+            # leaving clients hung mid-read.
+            for p in window:
+                if not p.event.is_set():
+                    p.error = e
+                    p.event.set()
+        finally:
+            self._inflight_sem.release()
 
     @staticmethod
     def _checked(backend, items: List[Item]) -> List[bool]:
@@ -328,8 +357,13 @@ class VerifierService:
             self._thread.join(timeout=5)
         if self._dispatcher:
             self._dispatcher.join(timeout=5)
+        with self._cond:
+            launch_threads = list(self._launch_threads)
+        for t in launch_threads:
+            t.join(timeout=5)
         if self._tracer.sink is not None and (
-            self._dispatcher is None or not self._dispatcher.is_alive()
+            (self._dispatcher is None or not self._dispatcher.is_alive())
+            and not any(t.is_alive() for t in launch_threads)
         ):
             # Only close once the dispatcher is provably done with it: a
             # join timeout (e.g. a minutes-long first XLA compile still in
@@ -366,6 +400,13 @@ def main() -> None:
     parser.add_argument(
         "--trace", default=None, help="JSONL per-dispatch trace file"
     )
+    parser.add_argument(
+        "--inflight",
+        type=int,
+        default=1,
+        help="overlapped launches: ship window N+1 while N executes "
+        "(hides host-side launch overhead; 1 = serial)",
+    )
     args = parser.parse_args()
     svc = VerifierService(
         host=args.host,
@@ -375,6 +416,7 @@ def main() -> None:
         flush_us=args.flush_us,
         flush_items=args.flush_items,
         trace_path=args.trace,
+        inflight=args.inflight,
     )
     print(f"verifier service on {svc.address} backend={args.backend}", flush=True)
     svc.server.serve_forever()

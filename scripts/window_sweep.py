@@ -28,7 +28,7 @@ sys.path.insert(0, REPO)
 sys.path.insert(0, os.path.join(REPO, "scripts"))
 
 from trace_report import expand_trace_args  # noqa: E402
-from launch_cost_model import window_stats  # noqa: E402
+from launch_cost_model import ON_HOST_LAUNCH_US, project, window_stats  # noqa: E402
 
 
 def run_cell(pipeline: int, flush_us: int, requests: int, kernel_rate: float):
@@ -47,7 +47,7 @@ def run_cell(pipeline: int, flush_us: int, requests: int, kernel_rate: float):
         )
         files = expand_trace_args([f"{trace_dir}-service"])
         win = window_stats(files)
-    per_item = 1.0 / kernel_rate + 100e-6 / win["items_per_launch"]
+    proj = project(kernel_rate, ON_HOST_LAUNCH_US, win["items_per_launch"])
     return {
         "config": "firehose f=1",
         "pipeline": pipeline,
@@ -56,7 +56,7 @@ def run_cell(pipeline: int, flush_us: int, requests: int, kernel_rate: float):
         "rounds_per_sec": res.rounds_per_sec,
         "items_per_launch": round(win["items_per_launch"], 2),
         "launches": win["launches"],
-        "projected_100us_per_sec": round(1.0 / per_item, 1),
+        "projected_100us_per_sec": proj["verifies_per_sec"],
     }
 
 

@@ -329,3 +329,51 @@ def test_service_trace_records_merged_windows(tmp_path):
     assert sum(e["rejected"] for e in batches) == 1
     assert all(e["secs"] >= 0 and e["replica"] == "service" for e in batches)
 
+
+
+def test_overlapped_launches_hide_launch_latency():
+    """inflight=2: window N+1 ships while N executes, so two slow
+    launches overlap in wall time; the serial default cannot. Verdict
+    slicing stays per-request in both modes."""
+
+    def run(inflight: int) -> float:
+        first_launch_started = threading.Event()
+
+        def slow_backend(items):
+            first_launch_started.set()
+            time.sleep(0.35)  # stands in for launch RTT; releases the GIL
+            return [p[0] == s[0] for p, m, s in items]
+
+        svc = VerifierService(backend=slow_backend, inflight=inflight).start()
+        try:
+            results = {}
+
+            def client(cid: int):
+                if cid == 2:
+                    # Only submit once launch 1 is provably in flight, so
+                    # the requests deterministically form TWO windows (a
+                    # sleep-based stagger could coalesce on a loaded box).
+                    assert first_launch_started.wait(10)
+                results[cid] = _send_batch(svc.address, [_item(cid, True)])
+
+            t0 = time.monotonic()
+            threads = [
+                threading.Thread(target=client, args=(c,)) for c in (1, 2)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=15)
+            elapsed = time.monotonic() - t0
+            assert results[1] == [True] and results[2] == [True]
+            assert svc.batches == 2, svc.batches
+            return elapsed
+        finally:
+            svc.stop()
+
+    serial = run(1)
+    overlapped = run(2)
+    # Serial: both 0.35s launches back-to-back (~0.7s). Overlapped: the
+    # second launch starts while the first runs (~0.35-0.45s).
+    assert serial > 0.64, f"serial run finished implausibly fast: {serial}"
+    assert overlapped < serial - 0.15, (serial, overlapped)
