@@ -57,15 +57,11 @@ int pbft_ed25519_verify(const uint8_t pub[32], const uint8_t* msg,
 }
 
 // Batch CPU verification (the control arm): items laid out as
-// pubs[32*i], msgs[32*i], sigs[64*i]; out[i] = 1 if valid.
+// pubs[32*i], msgs[32*i], sigs[64*i]; out[i] = 1 if valid. Random-linear-
+// combination fast path with per-item bisect fallback (core/ed25519.cc).
 void pbft_ed25519_verify_batch(const uint8_t* pubs, const uint8_t* msgs,
                                const uint8_t* sigs, uint8_t* out, size_t n) {
-  for (size_t i = 0; i < n; ++i) {
-    out[i] = pbft::ed25519_verify(pubs + 32 * i, msgs + 32 * i, 32,
-                                  sigs + 64 * i)
-                 ? 1
-                 : 0;
-  }
+  pbft::ed25519_verify_batch(pubs, msgs, sigs, n, out);
 }
 
 // --- Secure-link primitives (interop pinning vs pbft_tpu/net/secure.py).

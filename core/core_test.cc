@@ -410,6 +410,42 @@ void test_secure_channel_native() {
   CHECK(d.error().find("plaintext peer rejected") != std::string::npos);
 }
 
+void test_batch_verify_rlc() {
+  // The RLC + Pippenger batch path must agree with per-item verify:
+  // honest windows all-accept, corrupted items are isolated by the
+  // bisect (sizes straddle the RLC threshold and the window widths).
+  for (size_t n : {0, 1, 3, 8, 40, 200}) {
+    std::vector<uint8_t> pubs(32 * n), msgs(32 * n), sigs(64 * n), out(n);
+    for (size_t i = 0; i < n; ++i) {
+      uint8_t seed[32];
+      std::memset(seed, (int)(i + 1), 32);
+      std::memset(msgs.data() + 32 * i, (int)(0xA0 ^ i), 32);
+      pbft::ed25519_public_key(pubs.data() + 32 * i, seed);
+      pbft::ed25519_sign(sigs.data() + 64 * i, seed, msgs.data() + 32 * i, 32);
+    }
+    // Corrupt every 7th item (S byte), plus one pubkey (decompress-fail
+    // pre-check) when the batch is big enough.
+    std::set<size_t> bad;
+    for (size_t i = 0; i < n; i += 7) {
+      sigs[64 * i + 40] ^= 0x5A;
+      bad.insert(i);
+    }
+    if (n > 10) {
+      pubs[32 * 9] ^= 0xFF;
+      pubs[32 * 9 + 31] ^= 0x80;
+      bad.insert(9);
+    }
+    pbft::ed25519_verify_batch(pubs.data(), msgs.data(), sigs.data(), n,
+                               out.data());
+    for (size_t i = 0; i < n; ++i) {
+      bool expect = !bad.count(i);
+      CHECK(out[i] == (expect ? 1 : 0));
+      CHECK(pbft::ed25519_verify(pubs.data() + 32 * i, msgs.data() + 32 * i,
+                                 32, sigs.data() + 64 * i) == expect);
+    }
+  }
+}
+
 void test_remote_verifier_async() {
   // Drive the async verifier protocol against a socketpair standing in
   // for the service: request framing, partial-verdict reads, and the
@@ -470,6 +506,7 @@ int main() {
   test_view_change_native();
   test_stable_digest_majority_native();
   test_state_transfer_native();
+  test_batch_verify_rlc();
   test_remote_verifier_async();
   if (g_failures) {
     std::fprintf(stderr, "%d failure(s)\n", g_failures);

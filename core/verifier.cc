@@ -16,10 +16,17 @@ namespace pbft {
 
 std::vector<uint8_t> CpuVerifier::verify_batch(
     const std::vector<VerifyItem>& items) {
-  std::vector<uint8_t> out(items.size());
-  for (size_t i = 0; i < items.size(); ++i) {
-    out[i] = ed25519_verify(items[i].pub, items[i].msg, 32, items[i].sig) ? 1 : 0;
+  // Pack into the batch layout and use the RLC + Pippenger batch verify
+  // (core/ed25519.cc): one multi-scalar multiplication per honest window
+  // instead of one Shamir ladder per signature.
+  const size_t n = items.size();
+  std::vector<uint8_t> pubs(32 * n), msgs(32 * n), sigs(64 * n), out(n);
+  for (size_t i = 0; i < n; ++i) {
+    std::memcpy(pubs.data() + 32 * i, items[i].pub, 32);
+    std::memcpy(msgs.data() + 32 * i, items[i].msg, 32);
+    std::memcpy(sigs.data() + 64 * i, items[i].sig, 64);
   }
+  ed25519_verify_batch(pubs.data(), msgs.data(), sigs.data(), n, out.data());
   return out;
 }
 

@@ -1,8 +1,12 @@
 // Pluggable signature-verifier backends (BASELINE.json north_star):
 // `Verifier::verify_batch(items) -> bitmap`.
 //
-// - CpuVerifier: in-process per-item Ed25519 (core/ed25519.cc) — the control
-//   arm (BASELINE.md configs 1-2).
+// - CpuVerifier: in-process Ed25519 batch verification (core/ed25519.cc
+//   ed25519_verify_batch: random-linear-combination check + Pippenger MSM,
+//   bisecting failing windows to per-item verify) — the control arm
+//   (BASELINE.md configs 1-2). See the accept-set note in ed25519.cc for
+//   the one documented divergence from strict per-item semantics
+//   (colluding torsion-defect pairs inside one window).
 // - RemoteVerifier: ships (pubkey, digest, sig) batches over a local socket
 //   to the colocated JAX/TPU service (pbft_tpu/net/service.py), which runs
 //   one vmap'd XLA launch per batch and returns the validity bitmap.

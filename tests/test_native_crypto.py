@@ -94,3 +94,98 @@ def test_native_batch():
         want.append(i % 3 != 0)
     assert native.verify_batch(items) == want
     assert native.verify_batch([]) == []
+
+
+def _torsion_point():
+    """A nonzero small-order point: [L]P for an arbitrary curve point P
+    outside the prime subgroup (every nonzero torsion point has order
+    dividing 8 on edwards25519)."""
+    from pbft_tpu.crypto import ref
+
+    for y in range(2, 60):
+        enc = y.to_bytes(32, "little")
+        pt = ref.point_decompress(enc)
+        if pt is None:
+            continue
+        t = ref.scalar_mult(ref.L, pt)
+        if t != (0, 1):  # not the identity -> genuine torsion
+            return t
+    raise AssertionError("no torsion point found in scan range")
+
+
+def _craft_torsion_sig(seed: bytes, msg: bytes, defect):
+    """A signature with verification defect exactly -defect (a Byzantine
+    SIGNER crafting with its own secret key): R' = [r]B + defect,
+    s = r + H(R',A,M)*a, so [s]B - [h]A - R' = -defect — torsion-only,
+    invisible to any check that multiplies by the cofactor."""
+    from pbft_tpu.crypto import ref
+
+    a, _prefix = ref.secret_expand(seed)
+    pub_pt = ref.scalar_mult(a, ref.BASE)
+    pub = ref.point_compress(pub_pt)
+    r = 0x1234567  # any fixed nonce: determinism keeps the test stable
+    big_r = ref.point_compress(
+        ref.point_add(ref.scalar_mult(r, ref.BASE), defect)
+    )
+    h = ref._h512_int(big_r, pub, msg) % ref.L
+    s = (r + h * a) % ref.L
+    return pub, big_r + s.to_bytes(32, "little")
+
+
+def test_batch_rejects_a_lone_torsion_defect_deterministically():
+    """A crafted signature whose defect is a small-order point must be
+    rejected by the batch path exactly like per-item verify: the RLC
+    coefficients are forced === 1 (mod 8), so a lone torsion defect can
+    never cancel out of the combination (core/ed25519.cc accept-set
+    note). Repeated runs pin determinism across random coefficients."""
+    from pbft_tpu import native
+    from pbft_tpu.crypto import ref
+
+    t = _torsion_point()
+    seed = bytes(range(32))
+    msg = b"\x51" * 32
+    pub, crafted = _craft_torsion_sig(seed, msg, t)
+    assert not native.verify(pub, msg, crafted)
+    assert not ref.verify(pub, msg, crafted)
+
+    honest = []
+    for i in range(15):
+        s = bytes([i + 3]) * 32
+        m = bytes([0xC0 ^ i]) * 32
+        honest.append((native.public_key(s), m, native.sign(s, m)))
+    for _ in range(8):  # fresh random z_i every call
+        verdicts = native.verify_batch(honest[:7] + [(pub, msg, crafted)] + honest[7:])
+        assert verdicts[7] == 0 and sum(verdicts) == 15, verdicts
+
+
+def test_batch_torsion_pair_caveat_is_exactly_as_documented():
+    """The documented accept-set caveat (core/ed25519.cc): TWO crafted
+    signatures with cancelling torsion defects in ONE window pass the
+    RLC check — equivalent in power to sender equivocation, which PBFT
+    already tolerates. Per-item verify still rejects both; this test
+    pins the caveat so any change to the batch semantics is loud."""
+    from pbft_tpu import native
+    from pbft_tpu.crypto import ref
+
+    t = _torsion_point()
+    neg_t = (ref.P - t[0], t[1])  # -T: negate x
+    crafted = []
+    for i, defect in ((0, t), (1, neg_t)):
+        seed = bytes([i + 1]) * 32
+        msg = bytes([0xE0 + i]) * 32
+        pub, bad = _craft_torsion_sig(seed, msg, defect)
+        assert not native.verify(pub, msg, bad)  # per-item: rejected
+        crafted.append((pub, msg, bad))
+    honest = []
+    for i in range(10):
+        s = bytes([i + 9]) * 32
+        m = bytes([0x99 ^ i]) * 32
+        honest.append((native.public_key(s), m, native.sign(s, m)))
+    # Same window: the pair's defects cancel ((z1 - z2) T = 0 since
+    # 8 | z1 - z2 and T has order dividing 8) -> batch accepts the pair.
+    verdicts = native.verify_batch(honest + crafted)
+    assert verdicts == [True] * 12, verdicts
+    # Split windows (bisect below the RLC threshold): per-item authority
+    # rejects each crafted signature alone.
+    assert native.verify_batch([crafted[0]]) == [False]
+    assert native.verify_batch([crafted[1]]) == [False]
