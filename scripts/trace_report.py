@@ -41,6 +41,12 @@ def report(files) -> dict:
     for path in files:
         events = load(path)
         vb = [e for e in events if e.get("ev") == "verify_batch"]
+        # Failed merged windows (service trace): their per-request retries
+        # are the verify_batch events; surface the failure count so a run
+        # with backend trouble reads as such.
+        failed = [e for e in events if e.get("ev") == "verify_window_failed"]
+        if failed:
+            print(f"{path.name}: {len(failed)} FAILED merged windows")
         # Both runtimes emit "view_change_start" (core/net.cc
         # trace_view_change, server.py _timer_loop).
         vcs = [e for e in events if e.get("ev") == "view_change_start"]
