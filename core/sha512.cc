@@ -56,7 +56,9 @@ void sha512(uint8_t out[64], const uint8_t* in, size_t inlen) {
     rem -= 128;
   }
   uint8_t block[256] = {0};
-  std::memcpy(block, in + (inlen - rem), rem);
+  // rem == 0 also covers in == nullptr (empty message): memcpy with a
+  // null source is UB even at length zero.
+  if (rem) std::memcpy(block, in + (inlen - rem), rem);
   block[rem] = 0x80;
   size_t nblocks = (rem + 1 + 16 <= 128) ? 1 : 2;
   uint64_t bits = static_cast<uint64_t>(inlen) * 8;
