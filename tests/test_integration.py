@@ -433,3 +433,42 @@ def test_view_change_fires_under_accumulation_window():
             assert result == "awesome!"
         finally:
             client.close()
+
+
+def test_cluster_survives_slow_verifier_launches():
+    """Async verify dispatch under a SLOW service (stands in for a real
+    XLA launch): the daemons must keep draining sockets during the
+    round-trip — pipelined requests commit, and the windows accumulate
+    across the launch instead of the event loop stalling per batch."""
+    import time as _time
+
+    from pbft_tpu.net.service import native_backend
+
+    calls = []
+
+    def slow_native(items):
+        calls.append(len(items))
+        _time.sleep(0.25)  # emulate launch RTT; releases the GIL
+        return native_backend(items)
+
+    svc = VerifierService(backend=slow_native).start()
+    try:
+        with LocalCluster(n=4, verifier=svc.address) as cluster:
+            clients = [PbftClient(cluster.config) for _ in range(4)]
+            try:
+                t0 = _time.monotonic()
+                reqs = [c.request(f"slow-launch-{i}") for i, c in enumerate(clients)]
+                for c, r in zip(clients, reqs):
+                    assert c.wait_result(r.timestamp, timeout=60) == "awesome!"
+                elapsed = _time.monotonic() - t0
+            finally:
+                for c in clients:
+                    c.close()
+        # 4 concurrent rounds x ~5 verify phases each through 0.25s
+        # launches: a blocking loop would serialize every per-replica
+        # window (dozens of sequential 0.25s stalls); the async loop
+        # overlaps them across replicas and coalesces per daemon.
+        assert elapsed < 15, elapsed
+        assert max(calls) > 1, f"no window accumulated during launches: {calls}"
+    finally:
+        svc.stop()
