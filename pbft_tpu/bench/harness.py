@@ -37,6 +37,7 @@ class BenchResult:
     verifier: str
     byzantine: bool = False
     pipeline: int = 1  # in-flight requests per nominal client (native arms)
+    service_inflight: int = 1  # overlapped service launches (native-tpu arm)
 
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self))
@@ -319,7 +320,7 @@ def run_native_tpu_config(
         inflight=service_inflight,
     ).start()
     try:
-        return run_native_config(
+        res = run_native_config(
             index,
             requests=requests,
             verifier=service.address,
@@ -329,6 +330,10 @@ def run_native_tpu_config(
             secure=secure,
             pipeline=pipeline,
         )
+        # Recorded in the artifact: rows captured at different overlap
+        # settings must never be compared as like-for-like.
+        res.service_inflight = service_inflight
+        return res
     finally:
         service.stop()
 

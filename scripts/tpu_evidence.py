@@ -246,12 +246,16 @@ def main() -> None:
     if "protocol" in steps:
         # Configs 0-1 (4 replicas): the deployment shape. Larger configs
         # time-slice this box's single core and measure scheduling, not
-        # the verifier (BASELINE.md "Hardware context").
+        # the verifier (BASELINE.md "Hardware context"). The firehose is
+        # captured at BOTH overlap settings — over the tunneled ~200 ms
+        # PJRT hop, shipping window N+1 while N is in flight (inflight=2)
+        # should roughly halve the launch serialization that dominated
+        # the r3 jax-arm numbers, and the serial row is the control.
         outputs = []
-        cfgs = (0, 1)
-        for cfg in cfgs:
+        cfgs = ((0, 1), (1, 1), (1, 2))  # (config, service inflight)
+        for cfg, inflight in cfgs:
             res = run_step(
-                f"protocol-{cfg}",
+                f"protocol-{cfg}-in{inflight}",
                 [
                     py,
                     "-m",
@@ -260,8 +264,12 @@ def main() -> None:
                     "native-tpu",
                     "--config",
                     str(cfg),
+                    "--service-inflight",
+                    str(inflight),
                     "--trace-dir",
-                    os.path.join(BENCH_DIR, f"traces_{tag}_tpu_cfg{cfg}"),
+                    os.path.join(
+                        BENCH_DIR, f"traces_{tag}_tpu_cfg{cfg}_in{inflight}"
+                    ),
                 ],
                 timeout=1200,
             )
