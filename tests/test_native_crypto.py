@@ -189,3 +189,54 @@ def test_batch_torsion_pair_caveat_is_exactly_as_documented():
     # rejects each crafted signature alone.
     assert native.verify_batch([crafted[0]]) == [False]
     assert native.verify_batch([crafted[1]]) == [False]
+
+
+hypothesis = __import__("pytest").importorskip("hypothesis")
+from hypothesis import given, settings, strategies as st  # noqa: E402
+
+
+@settings(max_examples=25, deadline=None)
+@given(
+    n=st.integers(min_value=0, max_value=48),
+    corruption=st.lists(
+        st.tuples(
+            st.integers(min_value=0, max_value=47),  # item (mod n)
+            st.sampled_from(["sig_r", "sig_s", "pub", "msg", "s_ge_l"]),
+            st.integers(min_value=0, max_value=31),  # byte offset
+        ),
+        max_size=6,
+    ),
+)
+def test_batch_verify_matches_per_item_under_fuzz(n, corruption):
+    """Property: for ANY mix of corruptions, the batch path's verdict
+    equals per-item native.verify for every item. (The only documented
+    exception — colluding torsion-defect pairs — needs secret-key
+    crafting that byte-level corruption cannot produce.)"""
+    from pbft_tpu import native
+
+    if n == 0:
+        assert native.verify_batch([]) == []
+        return
+    items = []
+    for i in range(n):
+        seed = bytes([i + 1, 0x33]) * 16
+        msg = bytes([0x70 ^ i]) * 32
+        items.append((native.public_key(seed), msg, native.sign(seed, msg)))
+    for which, kind, off in corruption:
+        i = which % n
+        pub, msg, sig = items[i]
+        if kind == "sig_r":
+            sig = sig[:off] + bytes([sig[off] ^ 0x80]) + sig[off + 1 :]
+        elif kind == "sig_s":
+            j = 32 + off
+            sig = sig[:j] + bytes([sig[j] ^ 0x40]) + sig[j + 1 :]
+        elif kind == "pub":
+            pub = pub[:off] + bytes([pub[off] ^ 0x20]) + pub[off + 1 :]
+        elif kind == "msg":
+            msg = msg[:off] + bytes([msg[off] ^ 0x10]) + msg[off + 1 :]
+        else:  # s >= L: a non-canonical scalar must be rejected pre-RLC
+            sig = sig[:32] + b"\xff" * 31 + b"\x1f"
+        items[i] = (pub, msg, sig)
+    batch = native.verify_batch(items)
+    single = [native.verify(p, m, s) for p, m, s in items]
+    assert batch == single
