@@ -43,8 +43,17 @@ T0 = time.monotonic()
 
 def run_step(name: str, cmd, env_extra=None, timeout=900, out_json=None):
     """Run one capture step in a killable subprocess; returns parsed JSON
-    from the last {...} stdout line when out_json is set."""
+    from the last {...} stdout line when out_json is set. Skipped (None)
+    when a foreign bench.py is running — the TPU is effectively
+    exclusive and the scoring run must never be raced for the device."""
     import bench
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from tpu_watch import bench_running
+
+    if bench_running():
+        log(f"step {name}: SKIPPED (a bench.py owns the chip)")
+        return None
 
     from pbft_tpu.utils.cache import host_keyed_cache_dir
 

@@ -46,6 +46,31 @@ def probe(timeout_s: float = 60.0) -> bool:
     return bench._probe_tpu(timeout_s=timeout_s, attempts=1, gap_s=0.0)
 
 
+def bench_running() -> bool:
+    """True when a foreign bench.py process is alive (e.g. the driver's
+    scoring run): the TPU is effectively exclusive, so capture must
+    yield rather than wedge the run that gets recorded. Matched by exact
+    argv element — a substring match (pgrep -f) would hit any process
+    whose arguments merely MENTION bench.py."""
+    me = os.getpid()
+    try:
+        pids = [int(d) for d in os.listdir("/proc") if d.isdigit()]
+    except OSError:
+        return False
+    for pid in pids:
+        if pid == me:
+            continue
+        try:
+            with open(f"/proc/{pid}/cmdline", "rb") as fh:
+                argv = fh.read().split(b"\0")
+        except OSError:
+            continue
+        for arg in argv:
+            if arg == b"bench.py" or arg.endswith(b"/bench.py"):
+                return True
+    return False
+
+
 def remaining_steps(tag: str) -> list:
     """Steps whose artifact does not exist yet."""
     artifacts = {
@@ -117,6 +142,17 @@ def main() -> None:
             git_commit(args.tag)
             return
         probes += 1
+        if bench_running():
+            # The driver's scoring bench (or any other bench.py) owns the
+            # chip right now: never race it for the device — its number
+            # is the one that counts. (tpu_evidence re-checks this before
+            # every capture step too, bounding a mid-capture race to one
+            # step.)
+            log("bench.py running elsewhere; yielding this cycle")
+            if args.once:
+                return
+            time.sleep(args.interval)
+            continue
         if probe():
             log(f"tunnel UP after {probes} probes; capturing steps {steps}")
             rc = subprocess.run(
