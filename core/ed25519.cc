@@ -4,6 +4,7 @@
 
 #include <array>
 #include <atomic>
+#include <cstdio>
 #include <cstring>
 #include <vector>
 
@@ -662,6 +663,14 @@ void batch_coeffs_random(uint8_t* buf, size_t n) {
     if (r > 0) {
       off += (size_t)r;
       continue;
+    }
+    // getrandom unavailable/interrupted: /dev/urandom next (same tiering
+    // as core/secure.cc fill_random) before the last-resort counter.
+    if (FILE* f = std::fopen("/dev/urandom", "rb")) {
+      size_t got = std::fread(buf + off, 1, n - off, f);
+      std::fclose(f);
+      off += got;
+      if (got > 0) continue;
     }
     if (++failures > 16) {
       // No entropy: fall back to a per-process counter hashed through

@@ -40,9 +40,22 @@ bool RemoteVerifier::ensure_connected() {
   if (fd_ >= 0) return true;
   // Best-effort: a roomier send buffer widens the async write budget
   // (the kernel clamps to wmem_max without privileges; harmless if so).
-  auto grow_sndbuf = [](int fd) {
+  // The async item budget is then DERIVED from what the kernel actually
+  // granted — begin_batch's blocking write must always fit the buffer,
+  // or the event loop would stall for exactly the round-trip the async
+  // path exists to hide.
+  auto grow_sndbuf = [this](int fd) {
     int want = 1 << 20;
     ::setsockopt(fd, SOL_SOCKET, SO_SNDBUF, &want, sizeof(want));
+    int got = 0;
+    socklen_t len = sizeof(got);
+    if (::getsockopt(fd, SOL_SOCKET, SO_SNDBUF, &got, &len) == 0 && got > 0) {
+      // Linux reports the doubled value (bookkeeping overhead included);
+      // budget on half of it, minus the 4-byte header.
+      size_t payload = (size_t)got / 2;
+      async_budget_items_ = payload > 132 ? (payload - 4) / 128 : 1;
+      if (async_budget_items_ > 4096) async_budget_items_ = 4096;
+    }
   };
   if (!target_.empty() && target_[0] == '/') {
     fd_ = ::socket(AF_UNIX, SOCK_STREAM, 0);
@@ -124,19 +137,13 @@ std::vector<uint8_t> RemoteVerifier::verify_batch(
   return out;
 }
 
-// Largest batch dispatched asynchronously: the request must fit the
-// socket send buffer so the (blocking) write below cannot stall the
-// event loop while the service is busy inside its own launch. Linux's
-// default wmem is ~208 KiB; 1,500 items encode to 188 KiB. Bigger
-// windows simply take the caller's synchronous path — the pre-async
-// behavior, and rare (the service's own merge cap is 4096).
-static constexpr size_t kMaxAsyncItems = 1500;
-
 bool RemoteVerifier::begin_batch(const std::vector<VerifyItem>& items) {
-  if (items.empty() || items.size() > kMaxAsyncItems || inflight_) {
-    return false;
-  }
+  if (items.empty() || inflight_) return false;
   if (!ensure_connected()) return false;
+  // Batches beyond the measured send-buffer budget take the caller's
+  // synchronous path — the pre-async behavior, and rare (the service's
+  // own merge cap is 4096).
+  if (items.size() > async_budget_items_) return false;
   auto buf = encode_request(items);
   if (!write_all(fd_, buf.data(), buf.size())) {
     ::close(fd_);

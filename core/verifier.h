@@ -86,6 +86,10 @@ class RemoteVerifier : public Verifier {
   bool inflight_ = false;
   std::vector<uint8_t> resp_;  // verdict bytes received so far
   size_t expect_ = 0;
+  // Largest batch begin_batch will ship: derived from the connection's
+  // actual SO_SNDBUF so the blocking request write always fits the
+  // kernel buffer (default = safe under Linux's stock ~208 KiB wmem).
+  size_t async_budget_items_ = 1500;
 };
 
 }  // namespace pbft

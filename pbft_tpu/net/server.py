@@ -121,12 +121,15 @@ class AsyncReplicaServer:
         self.frames_in = 0
         # Reply-dial pacing (mirrors core/net.cc start_reply_dial): the
         # reply address is UNTRUSTED client input, so dials are
-        # deadline-bounded, capped in flight, and deduped per address —
-        # a burst of black-holed addresses must not accumulate tasks/FDs
-        # for the OS connect timeout. A dropped reply is re-fetched from
-        # the reply cache on client retransmission (PBFT §4.1).
+        # deadline-bounded, capped in flight, and serialized per address
+        # (an asyncio.Lock wakes waiters FIFO, so replies to one client
+        # go out in order with zero polling) — a burst of black-holed
+        # addresses must not accumulate tasks/FDs for the OS connect
+        # timeout. A dropped reply is re-fetched from the reply cache on
+        # client retransmission (PBFT §4.1).
         self._reply_dial_sem = asyncio.Semaphore(32)
-        self._reply_addrs_in_flight: set = set()
+        self._reply_addr_locks: Dict[str, asyncio.Lock] = {}
+        self._reply_addr_refs: Dict[str, int] = {}
         # Progress timer state (mirrors core/net.cc check_progress_timer).
         self._waiting_requests: Dict[Tuple[str, int], float] = {}
         self._timer_deadline: Optional[float] = None
@@ -510,37 +513,45 @@ class AsyncReplicaServer:
                 self._peer_links.pop(dest, None)
 
     async def _dial_reply(self, client_addr: str, reply: ClientReply) -> None:
-        # One dial per address at a time — but a LATER reply to the same
+        # One dial per address at a time — a LATER reply to the same
         # address is a distinct message (the client may already be on its
-        # next request), so wait for the slot rather than drop, bounded by
-        # the same ~6 s TTL the C++ reply backlog uses (core/net.cc).
+        # next request), so queue on the address lock (FIFO) rather than
+        # drop, bounded by the same ~6 s TTL the C++ reply backlog uses
+        # (core/net.cc). Lock entries are refcounted away when idle.
         deadline = time.monotonic() + 6.0
-        while client_addr in self._reply_addrs_in_flight:
-            if time.monotonic() >= deadline:
-                return  # expired: client retransmission re-fetches (§4.1)
-            await asyncio.sleep(0.05)
-        self._reply_addrs_in_flight.add(client_addr)
+        lock = self._reply_addr_locks.setdefault(client_addr, asyncio.Lock())
+        self._reply_addr_refs[client_addr] = (
+            self._reply_addr_refs.get(client_addr, 0) + 1
+        )
         try:
-            async with self._reply_dial_sem:
+            async with lock:
                 if time.monotonic() >= deadline:
-                    # Expired while queued for a dial slot (e.g. behind a
-                    # burst of black-holed addresses): a reply this stale
-                    # is the retransmission path's job now — dialing it
-                    # would keep the backlog alive long past the TTL.
-                    return
-                host, _, port = client_addr.rpartition(":")
-                reply = self._corrupt_sig(reply)
-                try:
-                    _, writer = await asyncio.wait_for(
-                        asyncio.open_connection(host, int(port)), timeout=3.0
-                    )
-                    writer.write(reply.canonical() + b"\n")
-                    await asyncio.wait_for(writer.drain(), timeout=3.0)
-                    writer.close()
-                except (OSError, ValueError, asyncio.TimeoutError):
-                    pass  # client gone / black-holed address
+                    return  # expired in the queue: retransmission (§4.1)
+                async with self._reply_dial_sem:
+                    if time.monotonic() >= deadline:
+                        # Expired waiting for a dial slot (e.g. behind a
+                        # burst of black-holed addresses): a reply this
+                        # stale is the retransmission path's job now.
+                        return
+                    host, _, port = client_addr.rpartition(":")
+                    reply = self._corrupt_sig(reply)
+                    try:
+                        _, writer = await asyncio.wait_for(
+                            asyncio.open_connection(host, int(port)),
+                            timeout=3.0,
+                        )
+                        writer.write(reply.canonical() + b"\n")
+                        await asyncio.wait_for(writer.drain(), timeout=3.0)
+                        writer.close()
+                    except (OSError, ValueError, asyncio.TimeoutError):
+                        pass  # client gone / black-holed address
         finally:
-            self._reply_addrs_in_flight.discard(client_addr)
+            refs = self._reply_addr_refs[client_addr] - 1
+            if refs:
+                self._reply_addr_refs[client_addr] = refs
+            else:
+                del self._reply_addr_refs[client_addr]
+                self._reply_addr_locks.pop(client_addr, None)
 
     # -- request/progress timer (PBFT §4.4 liveness) -------------------------
 

@@ -317,18 +317,25 @@ class VerifierService:
                 except Exception as e:  # noqa: BLE001 - handed to submitter
                     p.error = e
                 if self._tracer.enabled:
-                    self._tracer.event(
-                        "verify_batch",
-                        replica="service",
-                        size=len(p.items),
-                        requests=1,
-                        rejected=(
-                            p.verdicts.count(False)
-                            if p.verdicts is not None
-                            else -1
-                        ),
-                        secs=round(time.monotonic() - t1, 6),
-                    )
+                    if p.verdicts is not None:
+                        self._tracer.event(
+                            "verify_batch",
+                            replica="service",
+                            size=len(p.items),
+                            requests=1,
+                            rejected=p.verdicts.count(False),
+                            secs=round(time.monotonic() - t1, 6),
+                        )
+                    else:
+                        # NOT a verify_batch event: trace_report sums the
+                        # rejected field over verify_batch events, and an
+                        # errored retry has no verdicts to count.
+                        self._tracer.event(
+                            "verify_batch_error",
+                            replica="service",
+                            size=len(p.items),
+                            secs=round(time.monotonic() - t1, 6),
+                        )
                 p.event.set()
             return
         off = 0

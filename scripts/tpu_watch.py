@@ -88,41 +88,81 @@ def remaining_steps(tag: str) -> list:
 
 
 def git_commit(tag: str) -> None:
-    """Commit whatever capture artifacts exist under benchmarks/. Retries
-    around the index lock: the builder session commits concurrently with
-    this watcher. (.jax_cache is gitignored; warm compiles persist on disk
-    for the same-workspace bench run without going through git.)"""
+    """Commit whatever capture artifacts exist under benchmarks/ WITHOUT
+    touching the shared index: the builder session commits concurrently,
+    and anything this watcher staged in the shared index would be
+    silently swept into the builder's next plain `git commit`. A private
+    GIT_INDEX_FILE builds the tree; an atomic compare-and-swap on HEAD
+    (update-ref with the old value) publishes it, retrying on races.
+    (.jax_cache is gitignored; warm compiles persist on disk for the
+    same-workspace bench run without going through git.)"""
     msg = (
         f"Capture on-chip {tag} benchmark artifacts\n\n"
         "Recorded by scripts/tpu_watch.py during a live tunnel window.\n\n"
         "No-Verification-Needed: benchmark artifact data only"
     )
-    for attempt in range(6):
-        add = subprocess.run(
-            ["git", "add", "-A", "--", "benchmarks"],
-            cwd=REPO,
-            capture_output=True,
-        )
-        diff = subprocess.run(
-            ["git", "diff", "--cached", "--quiet", "--", "benchmarks"], cwd=REPO
-        )
-        if add.returncode == 0 and diff.returncode == 0:
-            log("git: nothing new to commit")
-            return
-        # Pathspec-limited commit: the builder session works (and stages)
-        # concurrently in this repo — only the capture paths may land here.
-        commit = subprocess.run(
-            ["git", "commit", "-m", msg, "--", "benchmarks"],
+    index = os.path.join(REPO, ".git", "tpu-watch-index")
+    env = dict(os.environ, GIT_INDEX_FILE=index)
+
+    def git(args, use_env=False):
+        return subprocess.run(
+            ["git"] + args,
             cwd=REPO,
             capture_output=True,
             text=True,
+            env=env if use_env else None,
         )
-        if commit.returncode == 0:
-            log("git: committed capture artifacts")
-            return
-        log(f"git: commit attempt {attempt + 1} failed: {commit.stderr.strip()[:200]}")
-        time.sleep(5)
-    log("git: giving up; artifacts remain in the working tree")
+
+    try:
+        for attempt in range(6):
+            head = git(["rev-parse", "HEAD"]).stdout.strip()
+            if not head:
+                log("git: no HEAD; skipping commit")
+                return
+            if (
+                git(["read-tree", "HEAD"], use_env=True).returncode != 0
+                or git(
+                    ["add", "-A", "--", "benchmarks"], use_env=True
+                ).returncode
+                != 0
+            ):
+                log(f"git: private-index staging failed (attempt {attempt + 1})")
+                time.sleep(5)
+                continue
+            tree = git(["write-tree"], use_env=True).stdout.strip()
+            head_tree = git(["rev-parse", "HEAD^{tree}"]).stdout.strip()
+            if tree == head_tree:
+                log("git: nothing new to commit")
+                return
+            commit = git(["commit-tree", tree, "-p", head, "-m", msg])
+            new = commit.stdout.strip()
+            if commit.returncode != 0 or not new:
+                log(f"git: commit-tree failed: {commit.stderr.strip()[:200]}")
+                time.sleep(5)
+                continue
+            # CAS on HEAD: fails (and retries on a fresh base) if the
+            # builder committed meanwhile.
+            cas = git(["update-ref", "HEAD", new, head])
+            if cas.returncode == 0:
+                log(f"git: committed capture artifacts ({new[:12]})")
+                # Resync the SHARED index for the committed paths: it is
+                # now stale vs the new HEAD, which would read as staged
+                # deletions to the builder (and a `git commit -a` there
+                # could really delete them). Staging files identical to
+                # HEAD is a no-op state — safe even mid-builder-workflow.
+                for _ in range(3):
+                    if git(["add", "-A", "--", "benchmarks"]).returncode == 0:
+                        break
+                    time.sleep(2)
+                return
+            log(f"git: HEAD moved; retrying (attempt {attempt + 1})")
+            time.sleep(2)
+        log("git: giving up; artifacts remain in the working tree")
+    finally:
+        try:
+            os.unlink(index)
+        except OSError:
+            pass
 
 
 def main() -> None:
