@@ -9,8 +9,26 @@
 #include "blake2b.h"
 #include "ed25519.h"
 #include "messages.h"
+#include "metrics.h"
 #include "secure.h"
 #include "sha512.h"
+
+namespace {
+// Shared copy-out for the newline-joined name tables below.
+size_t join_names(const std::vector<std::string>& names, char* out,
+                  size_t cap) {
+  std::string joined;
+  for (const auto& n : names) {
+    if (!joined.empty()) joined.push_back('\n');
+    joined += n;
+  }
+  if (joined.size() < cap) {
+    std::memcpy(out, joined.data(), joined.size());
+    out[joined.size()] = '\0';
+  }
+  return joined.size();
+}
+}  // namespace
 
 extern "C" {
 
@@ -62,6 +80,37 @@ int pbft_ed25519_verify(const uint8_t pub[32], const uint8_t* msg,
 void pbft_ed25519_verify_batch(const uint8_t* pubs, const uint8_t* msgs,
                                const uint8_t* sigs, uint8_t* out, size_t n) {
   pbft::ed25519_verify_batch(pubs, msgs, sigs, n, out);
+}
+
+// --- Observability schema-parity surface (core/metrics.cc tables).
+//
+// The mixed-runtime contract (pbft_tpu/utils/trace_schema.py) requires
+// both runtimes to emit identical metric and trace-event names; these
+// exports let the Python parity test read the names the NATIVE runtime
+// actually compiled in (scripts/check_trace_schema.py lints the sources
+// statically; this is the runtime check). Newline-joined into out
+// (NUL-terminated when it fits); returns the joined length.
+
+size_t pbft_metric_names(char* out, size_t cap) {
+  return join_names(pbft::Metrics::metric_names(), out, cap);
+}
+
+size_t pbft_trace_event_names(char* out, size_t cap) {
+  return join_names(pbft::Metrics::trace_event_names(), out, cap);
+}
+
+// Render an empty (zero-valued) metrics registry as Prometheus text —
+// the exposition-format parity check against the Python renderer.
+size_t pbft_metrics_render_empty(const char* replica_label, char* out,
+                                 size_t cap) {
+  pbft::Metrics m;
+  m.enabled = true;
+  std::string text = m.render_prometheus(replica_label);
+  if (text.size() < cap) {
+    std::memcpy(out, text.data(), text.size());
+    out[text.size()] = '\0';
+  }
+  return text.size();
 }
 
 // --- Secure-link primitives (interop pinning vs pbft_tpu/net/secure.py).

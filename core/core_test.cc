@@ -492,6 +492,21 @@ void test_remote_verifier_async() {
   ::close(sv[1]);
   CHECK(rv.poll_result(&verdicts, &failed));
   CHECK(failed);
+
+  // Wedge-deadline cancellation (net.cc check_verify_deadline): the
+  // transport drops — even with partial verdicts already received — so a
+  // late reply cannot mis-pair with the next batch, and the verifier is
+  // immediately reusable.
+  int sv2[2];
+  CHECK(::socketpair(AF_UNIX, SOCK_STREAM, 0, sv2) == 0);
+  rv.adopt_fd_for_test(sv2[0]);
+  CHECK(rv.begin_batch(items));
+  uint8_t part3[1] = {1};
+  CHECK(write(sv2[1], part3, 1) == 1);
+  CHECK(!rv.poll_result(&verdicts, &failed));  // partial: still in flight
+  rv.cancel_inflight();
+  CHECK(rv.async_fd() == -1);  // no longer polled by the event loop
+  ::close(sv2[1]);
 }
 
 }  // namespace

@@ -1,0 +1,153 @@
+#include "metrics.h"
+
+#include <algorithm>
+#include <cmath>
+#include <cstdio>
+
+namespace pbft {
+
+namespace {
+
+// Bucket edges mirror pbft_tpu/utils/trace_schema.py
+// (LATENCY_BUCKETS_S / BATCH_SIZE_BUCKETS) — the lint compares values.
+const std::vector<double> kLatencyBuckets = {
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+    0.05,   0.1,     0.25,   0.5,   1.0,    2.5,   5.0,  10.0};
+const std::vector<double> kSizeBuckets = {1,   2,   4,   8,    16,   32,  64,
+                                          128, 256, 512, 1024, 2048, 4096};
+
+const char* kCounterNames[] = {
+    "pbft_frames_in_total",          "pbft_executed_total",
+    "pbft_view_changes_total",       "pbft_verify_batches_total",
+    "pbft_verify_items_total",       "pbft_verify_rejected_total",
+    "pbft_verify_deadline_fired_total",
+};
+const char* kGaugeNames[] = {
+    "pbft_verify_queue_depth",
+    "pbft_verify_inflight_age_seconds",
+};
+// name -> uses the size bucket ladder (else latency).
+const std::pair<const char*, bool> kHistogramNames[] = {
+    {"pbft_verify_batch_size", true},
+    {"pbft_verify_seconds", false},
+    {"pbft_phase_pre_prepare_seconds", false},
+    {"pbft_phase_prepare_seconds", false},
+    {"pbft_phase_commit_seconds", false},
+    {"pbft_phase_reply_seconds", false},
+    {"pbft_request_reply_seconds", false},
+};
+
+// JSONL trace events net.cc emits (trace_batch, trace_view_change,
+// trace_consensus_span, trace_verify_deadline).
+const char* kTraceEventNames[] = {
+    "verify_batch",
+    "view_change_start",
+    "consensus_span",
+    "verify_deadline_fired",
+};
+
+// Integer-valued samples print without a decimal point, matching the
+// Python renderer's _fmt (so mixed-runtime scrapes diff cleanly).
+std::string fmt_value(double v) {
+  char buf[64];
+  if (std::isfinite(v) && v == std::floor(v) && std::fabs(v) < 1e15) {
+    std::snprintf(buf, sizeof(buf), "%lld", (long long)v);
+  } else {
+    std::snprintf(buf, sizeof(buf), "%g", v);
+  }
+  return buf;
+}
+
+}  // namespace
+
+void MetricHistogram::observe(double v) {
+  size_t i = std::lower_bound(edges.begin(), edges.end(), v) - edges.begin();
+  counts[i] += 1;
+  sum += v;
+  count += 1;
+}
+
+Metrics::Metrics() {
+  for (const char* n : kCounterNames) counters_[n] = 0;
+  for (const char* n : kGaugeNames) gauges_[n] = 0;
+  for (const auto& [n, size_buckets] : kHistogramNames) {
+    MetricHistogram h;
+    h.edges = size_buckets ? kSizeBuckets : kLatencyBuckets;
+    h.counts.assign(h.edges.size() + 1, 0);
+    histograms_[n] = std::move(h);
+  }
+}
+
+void Metrics::inc(const char* name, int64_t n) {
+  if (!enabled) return;
+  auto it = counters_.find(name);
+  if (it != counters_.end()) it->second += n;
+}
+
+void Metrics::set_gauge(const char* name, double v) {
+  if (!enabled) return;
+  auto it = gauges_.find(name);
+  if (it != gauges_.end()) it->second = v;
+}
+
+void Metrics::observe(const char* name, double v) {
+  if (!enabled) return;
+  auto it = histograms_.find(name);
+  if (it != histograms_.end()) it->second.observe(v);
+}
+
+std::string Metrics::render_prometheus(
+    const std::string& replica_label) const {
+  const std::string label = "{replica=\"" + replica_label + "\"}";
+  const std::string label_open = "{replica=\"" + replica_label + "\",";
+  std::string out;
+  // One sorted pass over all names (maps are sorted; merge by name so the
+  // ordering matches the Python renderer's single sorted dict).
+  std::vector<std::string> names;
+  for (const auto& [n, _] : counters_) names.push_back(n);
+  for (const auto& [n, _] : gauges_) names.push_back(n);
+  for (const auto& [n, _] : histograms_) names.push_back(n);
+  std::sort(names.begin(), names.end());
+  for (const auto& name : names) {
+    if (auto c = counters_.find(name); c != counters_.end()) {
+      out += "# TYPE " + name + " counter\n";
+      out += name + label + " " + fmt_value((double)c->second) + "\n";
+    } else if (auto g = gauges_.find(name); g != gauges_.end()) {
+      out += "# TYPE " + name + " gauge\n";
+      out += name + label + " " + fmt_value(g->second) + "\n";
+    } else {
+      const MetricHistogram& h = histograms_.at(name);
+      out += "# TYPE " + name + " histogram\n";
+      int64_t cum = 0;
+      for (size_t i = 0; i < h.edges.size(); ++i) {
+        cum += h.counts[i];
+        out += name + "_bucket" + label_open + "le=\"" +
+               fmt_value(h.edges[i]) + "\"} " + fmt_value((double)cum) + "\n";
+      }
+      cum += h.counts.back();
+      out += name + "_bucket" + label_open + "le=\"+Inf\"} " +
+             fmt_value((double)cum) + "\n";
+      out += name + "_sum" + label + " " + fmt_value(h.sum) + "\n";
+      out += name + "_count" + label + " " + fmt_value((double)h.count) + "\n";
+    }
+  }
+  return out;
+}
+
+std::vector<std::string> Metrics::metric_names() {
+  std::vector<std::string> names;
+  for (const char* n : kCounterNames) names.push_back(n);
+  for (const char* n : kGaugeNames) names.push_back(n);
+  for (const auto& [n, _] : kHistogramNames) names.push_back(n);
+  std::sort(names.begin(), names.end());
+  return names;
+}
+
+std::vector<std::string> Metrics::trace_event_names() {
+  std::vector<std::string> names;
+  for (const char* n : kTraceEventNames) names.push_back(n);
+  std::sort(names.begin(), names.end());
+  return names;
+}
+
+}  // namespace pbft

@@ -1,0 +1,55 @@
+// Metrics registry for the native replica runtime — the C++ mirror of
+// pbft_tpu/utils/metrics.py. Metric names, types, and histogram bucket
+// edges are THE cross-runtime contract defined in
+// pbft_tpu/utils/trace_schema.py: a mixed cluster (pbftd + AsyncReplicaServer)
+// must expose identical series so one scrape config covers both.
+// scripts/check_trace_schema.py lints this file's name tables against the
+// manifest; capi.cc exports them for the runtime parity test.
+//
+// Discipline matches the tracer's (net.cc trace_batch): one `enabled`
+// check on every record path, single writer (the poll thread), and the
+// scrape snapshot is rendered on the same thread (the /metrics listener
+// is polled by the event loop), so no locking.
+#pragma once
+
+#include <cstdint>
+#include <map>
+#include <string>
+#include <vector>
+
+namespace pbft {
+
+struct MetricHistogram {
+  std::vector<double> edges;     // upper bounds, le semantics (v <= edge)
+  std::vector<int64_t> counts;   // edges.size() + 1 (last = +Inf)
+  double sum = 0;
+  int64_t count = 0;
+  void observe(double v);
+};
+
+class Metrics {
+ public:
+  Metrics();  // registers every manifest metric (zero-valued)
+
+  bool enabled = false;
+
+  void inc(const char* name, int64_t n = 1);
+  void set_gauge(const char* name, double v);
+  void observe(const char* name, double v);
+
+  // Prometheus exposition text; every sample carries replica="<label>"
+  // (series names and ordering match MetricsRegistry.render_prometheus).
+  std::string render_prometheus(const std::string& replica_label) const;
+
+  // Schema-parity surface (capi.cc): the metric / trace-event names this
+  // runtime emits, for comparison against the Python manifest.
+  static std::vector<std::string> metric_names();
+  static std::vector<std::string> trace_event_names();
+
+ private:
+  std::map<std::string, int64_t> counters_;
+  std::map<std::string, double> gauges_;
+  std::map<std::string, MetricHistogram> histograms_;
+};
+
+}  // namespace pbft

@@ -10,6 +10,7 @@
 
 #include <algorithm>
 #include <cerrno>
+#include <cmath>
 #include <cstdio>
 #include <cstring>
 #include <type_traits>
@@ -80,11 +81,16 @@ ReplicaServer::ReplicaServer(ClusterConfig cfg, int64_t id,
     : cfg_(cfg), id_(id), verifier_(std::move(verifier)) {
   std::memcpy(seed_, seed, 32);
   replica_ = std::make_unique<Replica>(cfg_, id_, seed);
+  // Consensus-phase spans: the hook costs one branch inside on_phase when
+  // neither metrics nor tracing is active (the Tracer discipline).
+  replica_->phase_hook = [this](const char* phase, int64_t view,
+                                int64_t seq) { on_phase(phase, view, seq); };
 }
 
 ReplicaServer::~ReplicaServer() {
   if (trace_fp_) std::fclose(trace_fp_);
   if (listen_fd_ >= 0) close(listen_fd_);
+  if (metrics_listen_fd_ >= 0) close(metrics_listen_fd_);
   for (auto& c : conns_)
     if (c->fd >= 0) close(c->fd);
   for (auto& [_, c] : peers_)
@@ -106,6 +112,30 @@ bool ReplicaServer::start() {
   getsockname(listen_fd_, (sockaddr*)&addr, &len);
   listen_port_ = ntohs(addr.sin_port);
   set_nonblocking(listen_fd_);
+  if (metrics_port_ >= 0) {
+    metrics_listen_fd_ = socket(AF_INET, SOCK_STREAM, 0);
+    sockaddr_in maddr{};
+    maddr.sin_family = AF_INET;
+    maddr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+    maddr.sin_port = htons((uint16_t)metrics_port_);
+    int mone = 1;
+    setsockopt(metrics_listen_fd_, SOL_SOCKET, SO_REUSEADDR, &mone,
+               sizeof(mone));
+    if (metrics_listen_fd_ < 0 ||
+        bind(metrics_listen_fd_, (sockaddr*)&maddr, sizeof(maddr)) != 0 ||
+        listen(metrics_listen_fd_, 16) != 0) {
+      std::fprintf(stderr, "replica %lld: metrics bind failed on port %d\n",
+                   (long long)id_, metrics_port_);
+      if (metrics_listen_fd_ >= 0) close(metrics_listen_fd_);
+      metrics_listen_fd_ = -1;
+    } else {
+      socklen_t mlen = sizeof(maddr);
+      getsockname(metrics_listen_fd_, (sockaddr*)&maddr, &mlen);
+      metrics_listen_port_ = ntohs(maddr.sin_port);
+      set_nonblocking(metrics_listen_fd_);
+      metrics_.enabled = true;
+    }
+  }
   if (!discovery_target_.empty()) {
     discovery_ =
         std::make_unique<Discovery>(discovery_target_, id_, listen_port_,
@@ -169,6 +199,20 @@ void ReplicaServer::poll_once(int timeout_ms) {
     verifier_pfd = pfds.size();
     pfds.push_back({verifier_->async_fd(), POLLIN, 0});
   }
+  size_t metrics_pfd = 0;
+  if (metrics_listen_fd_ >= 0) {
+    metrics_pfd = pfds.size();
+    pfds.push_back({metrics_listen_fd_, POLLIN, 0});
+  }
+  if (verify_inflight_ && verify_deadline_ms_ > 0) {
+    // Don't let a quiet cluster sleep past the wedge deadline.
+    auto rem = std::chrono::duration_cast<std::chrono::milliseconds>(
+                   inflight_start_ +
+                   std::chrono::milliseconds(verify_deadline_ms_) -
+                   std::chrono::steady_clock::now())
+                   .count();
+    timeout_ms = std::min<int64_t>(timeout_ms, std::max<int64_t>(rem, 0) + 1);
+  }
   int n = ::poll(pfds.data(), pfds.size(), timeout_ms);
   if (n < 0) return;
   if (pfds[0].revents & POLLIN) accept_ready();
@@ -186,6 +230,10 @@ void ReplicaServer::poll_once(int timeout_ms) {
       (pfds[verifier_pfd].revents & (POLLIN | POLLHUP | POLLERR))) {
     finish_verify_async();
   }
+  if (metrics_pfd != 0 && (pfds[metrics_pfd].revents & POLLIN)) {
+    serve_metrics_ready();
+  }
+  check_verify_deadline(std::chrono::steady_clock::now());
   // The batching window: everything that arrived this iteration verifies
   // as one batch (one XLA launch on the TPU backend). With an async
   // verifier this immediately dispatches the window that accumulated
@@ -296,6 +344,7 @@ void ReplicaServer::process_buffer(Conn& c) {
       auto msg = from_payload(payload);
       if (msg) {
         ++frames_in_;
+        metrics_.inc("pbft_frames_in_total");
         emit(replica_->receive(*msg));
       }
       if (c.rbuf.empty()) return;
@@ -420,6 +469,7 @@ bool ReplicaServer::handle_peer_frame(Conn& c, std::string payload) {
   auto msg = from_payload(payload);
   if (msg) {
     ++frames_in_;
+    metrics_.inc("pbft_frames_in_total");
     emit(replica_->receive(*msg));
   }
   return true;
@@ -506,9 +556,145 @@ void ReplicaServer::trace_view_change(int backoff) {
   std::fflush(trace_fp_);
 }
 
+// Consensus-phase spans (Replica::phase_hook target). Stamp indices:
+// 0=request (primary only), 1=pre_prepare, 2=prepared, 3=committed;
+// "executed" closes the span. Schemas/metric names are the cross-runtime
+// contract (pbft_tpu/utils/trace_schema.py) — the Python runtime's
+// ConsensusSpans must stay field-for-field identical.
+void ReplicaServer::on_phase(const char* phase, int64_t view, int64_t seq) {
+  if (!metrics_.enabled && !trace_fp_) return;
+  static constexpr size_t kMaxOpenSpans = 4096;
+  const double now = trace_now();
+  const std::pair<int64_t, int64_t> key{view, seq};
+  auto it = open_spans_.find(key);
+  if (std::strcmp(phase, "executed") != 0) {
+    if (it == open_spans_.end()) {
+      if (open_spans_.size() >= kMaxOpenSpans) {
+        open_spans_.erase(open_spans_.begin());  // abandoned slot
+      }
+      it = open_spans_
+               .emplace(key, std::array<double, 4>{NAN, NAN, NAN, NAN})
+               .first;
+    }
+    int idx = !std::strcmp(phase, "request")       ? 0
+              : !std::strcmp(phase, "pre_prepare") ? 1
+              : !std::strcmp(phase, "prepared")    ? 2
+                                                   : 3;
+    if (std::isnan(it->second[idx])) it->second[idx] = now;
+    return;
+  }
+  if (it == open_spans_.end()) return;  // evicted or never opened
+  const std::array<double, 4> s = it->second;
+  open_spans_.erase(it);
+  metrics_.inc("pbft_executed_total");
+  auto obs = [&](const char* name, double a, double b) {
+    if (!std::isnan(a) && !std::isnan(b)) {
+      metrics_.observe(name, std::max(0.0, b - a));
+    }
+  };
+  obs("pbft_phase_pre_prepare_seconds", s[0], s[1]);
+  obs("pbft_phase_prepare_seconds", s[1], s[2]);
+  obs("pbft_phase_commit_seconds", s[2], s[3]);
+  obs("pbft_phase_reply_seconds", s[3], now);
+  const double start = !std::isnan(s[0]) ? s[0] : s[1];
+  if (!std::isnan(start)) {
+    metrics_.observe("pbft_request_reply_seconds", std::max(0.0, now - start));
+  }
+  if (!trace_fp_) return;
+  char buf[512];
+  int off = std::snprintf(
+      buf, sizeof(buf),
+      "{\"ts\":%.6f,\"ev\":\"consensus_span\",\"replica\":%lld,"
+      "\"view\":%lld,\"seq\":%lld",
+      now, (long long)id_, (long long)view, (long long)seq);
+  const char* names[] = {"request", "pre_prepare", "prepared", "committed"};
+  for (int i = 0; i < 4; ++i) {
+    if (!std::isnan(s[i]) && off < (int)sizeof(buf)) {
+      off += std::snprintf(buf + off, sizeof(buf) - off, ",\"%s\":%.6f",
+                           names[i], s[i]);
+    }
+  }
+  if (off < (int)sizeof(buf)) {
+    off += std::snprintf(buf + off, sizeof(buf) - off, ",\"executed\":%.6f}",
+                         now);
+  }
+  std::fprintf(trace_fp_, "%s\n", buf);
+  std::fflush(trace_fp_);
+}
+
+std::string ReplicaServer::metrics_prometheus() const {
+  return metrics_.render_prometheus(std::to_string(id_));
+}
+
+void ReplicaServer::serve_metrics_ready() {
+  for (;;) {
+    int fd = accept(metrics_listen_fd_, nullptr, nullptr);
+    if (fd < 0) return;
+    // One-shot scrape: the request bytes are irrelevant (any GET gets the
+    // full exposition), so drain best-effort, answer, close. The body is
+    // a few KB — one blocking send fits the socket buffer.
+    char sink[1024];
+    (void)recv(fd, sink, sizeof(sink), MSG_DONTWAIT);
+    std::string body = metrics_prometheus();
+    char hdr[160];
+    int hn = std::snprintf(hdr, sizeof(hdr),
+                           "HTTP/1.0 200 OK\r\n"
+                           "Content-Type: text/plain; version=0.0.4\r\n"
+                           "Content-Length: %zu\r\n\r\n",
+                           body.size());
+    std::string resp(hdr, (size_t)hn);
+    resp += body;
+    (void)send(fd, resp.data(), resp.size(), MSG_NOSIGNAL);
+    (void)recv(fd, sink, sizeof(sink), MSG_DONTWAIT);  // avoid RST on close
+    close(fd);
+  }
+}
+
+void ReplicaServer::check_verify_deadline(
+    std::chrono::steady_clock::time_point now) {
+  if (!verify_inflight_) return;
+  const double age =
+      std::chrono::duration<double>(now - inflight_start_).count();
+  metrics_.set_gauge("pbft_verify_inflight_age_seconds", age);
+  if (verify_deadline_ms_ <= 0 ||
+      now - inflight_start_ < std::chrono::milliseconds(verify_deadline_ms_)) {
+    return;
+  }
+  // Wedged async verifier (ADVICE.md core/net.cc item): the connection is
+  // alive but the reply never comes, so verify_inflight_ would stay true
+  // forever. Drop the transport and run the CPU safety net on the batch —
+  // same degradation contract as a detected transport failure. Any late
+  // reply lands on a closed socket; it cannot double-deliver.
+  verifier_->cancel_inflight();
+  ++verify_deadline_fired_;
+  metrics_.inc("pbft_verify_deadline_fired_total");
+  if (trace_fp_) {
+    std::fprintf(trace_fp_,
+                 "{\"ts\":%.6f,\"ev\":\"verify_deadline_fired\","
+                 "\"replica\":%lld,\"size\":%lld,\"age_secs\":%.6f}\n",
+                 trace_now(), (long long)id_,
+                 (long long)inflight_items_.size(), age);
+    std::fflush(trace_fp_);
+  }
+  CpuVerifier safety_net;
+  auto verdicts = safety_net.verify_batch(inflight_items_);
+  auto dispatched_at = inflight_start_;
+  size_t n_items = inflight_items_.size();
+  verify_inflight_ = false;
+  inflight_items_.clear();
+  deliver_verified(n_items, dispatched_at, std::move(verdicts));
+  if (cfg_.verify_flush_us > 0 && replica_->pending_count() > 0) {
+    // Same backdating as finish_verify_async: what queued during the
+    // wedge has already over-waited — flush it on the next pass.
+    verify_window_open_ = true;
+    verify_window_start_ = dispatched_at;
+  }
+}
+
 void ReplicaServer::run_verify_batch() {
   if (verify_inflight_) return;  // accumulate; finish_verify_async delivers
   size_t pending = replica_->pending_count();
+  metrics_.set_gauge("pbft_verify_queue_depth", (double)pending);
   if (pending == 0) {
     verify_window_open_ = false;
     return;
@@ -551,13 +737,19 @@ void ReplicaServer::deliver_verified(size_t n_items,
                                      std::chrono::steady_clock::time_point t0,
                                      std::vector<uint8_t> verdicts) {
   ++batches_run_;
-  if (trace_fp_) {
+  if (metrics_.enabled || trace_fp_) {  // batch boundaries only
     int64_t rejected = 0;
     for (uint8_t v : verdicts) rejected += v ? 0 : 1;
-    trace_batch(
-        (int64_t)n_items, rejected,
+    double secs =
         std::chrono::duration<double>(std::chrono::steady_clock::now() - t0)
-            .count());
+            .count();
+    metrics_.inc("pbft_verify_batches_total");
+    metrics_.inc("pbft_verify_items_total", (int64_t)n_items);
+    metrics_.inc("pbft_verify_rejected_total", rejected);
+    metrics_.observe("pbft_verify_batch_size", (double)n_items);
+    metrics_.observe("pbft_verify_seconds", secs);
+    metrics_.set_gauge("pbft_verify_inflight_age_seconds", secs);
+    if (trace_fp_) trace_batch((int64_t)n_items, rejected, secs);
   }
   emit(replica_->deliver_verdicts(verdicts));
 }
@@ -663,6 +855,7 @@ void ReplicaServer::check_progress_timer() {
     // No progress within the timeout: suspect the primary. Exponential
     // backoff keeps cascading view changes from thrashing (§4.5.2).
     timer_backoff_ = std::min(timer_backoff_ * 2, 64);
+    metrics_.inc("pbft_view_changes_total");
     trace_view_change(timer_backoff_);
     emit(replica_->start_view_change());
   }
@@ -863,6 +1056,7 @@ std::string ReplicaServer::metrics_json() const {
   o["verify_batches"] = Json(batches_run_);
   o["reply_backlog"] = Json((int64_t)reply_backlog_.size());
   o["replies_dropped"] = Json(replies_dropped_);
+  o["verify_deadline_fired"] = Json(verify_deadline_fired_);
   o["executed_upto"] = Json(replica_->executed_upto());
   o["low_mark"] = Json(replica_->low_mark());
   o["view"] = Json(replica_->view());

@@ -15,6 +15,7 @@
 // north_star) emerges naturally from socket-level concurrency.
 #pragma once
 
+#include <array>
 #include <chrono>
 #include <cstdint>
 #include <cstdio>
@@ -27,6 +28,7 @@
 #include <vector>
 
 #include "discovery.h"
+#include "metrics.h"
 #include "replica.h"
 #include "secure.h"
 #include "verifier.h"
@@ -79,6 +81,24 @@ class ReplicaServer {
   int listen_port() const { return listen_port_; }
   // One JSON metrics line (counters + queue depths).
   std::string metrics_json() const;
+
+  // Prometheus scrape surface (metric names contracted with the Python
+  // runtime by pbft_tpu/utils/trace_schema.py): call before start() to
+  // listen on `port` (0 = ephemeral) and serve the registry as plaintext.
+  // Enabling this turns the metrics registry on; consensus-phase spans
+  // additionally feed the trace file when set_trace_file is active.
+  void set_metrics_port(int port) { metrics_port_ = port; }
+  int metrics_listen_port() const { return metrics_listen_port_; }
+  Metrics& metrics() { return metrics_; }
+  std::string metrics_prometheus() const;
+
+  // Wedged-async-verifier bound (ADVICE.md): an inflight remote launch
+  // older than this is abandoned — connection dropped, batch re-verified
+  // on the CPU safety net, verify_deadline_fired traced + counted.
+  // Generous default: a first XLA compile can legitimately take tens of
+  // seconds; the fallback is safe (the dropped reply goes nowhere) but
+  // thrashing it would waste the service's warm cache. 0 disables.
+  void set_verify_deadline_ms(int ms) { verify_deadline_ms_ = ms; }
 
   // Request/progress timer (PBFT §4.4 liveness): when a client request is
   // waiting (forwarded to the primary, or accepted pre-prepares sit
@@ -155,6 +175,15 @@ class ReplicaServer {
   std::unique_ptr<Replica> replica_;
   void trace_batch(int64_t size, int64_t rejected, double secs);
   void trace_view_change(int backoff);
+  // Consensus-phase spans (Replica::phase_hook target): stamps each
+  // transition; at "executed" observes the per-phase latency histograms
+  // and emits one consensus_span trace event (utils/trace_schema.py).
+  void on_phase(const char* phase, int64_t view, int64_t seq);
+  // Accept + answer /metrics scrapes (one-shot: write response, close).
+  void serve_metrics_ready();
+  // Abandon an over-deadline inflight async verify (see
+  // set_verify_deadline_ms); no-op unless wedged.
+  void check_verify_deadline(std::chrono::steady_clock::time_point now);
 
   FILE* trace_fp_ = nullptr;
   std::string discovery_target_;
@@ -215,6 +244,18 @@ class ReplicaServer {
   bool verify_inflight_ = false;
   std::vector<VerifyItem> inflight_items_;
   std::chrono::steady_clock::time_point inflight_start_{};
+  int verify_deadline_ms_ = 15000;
+  int64_t verify_deadline_fired_ = 0;  // surfaced in metrics_json
+
+  // Metrics registry + scrape listener (enabled by set_metrics_port).
+  Metrics metrics_;
+  int metrics_port_ = -1;
+  int metrics_listen_fd_ = -1;
+  int metrics_listen_port_ = 0;
+  // Open consensus-phase spans, (view, seq) -> stamps[PHASES] (NaN =
+  // phase not seen). Bounded: slots that never execute (abandoned view)
+  // are evicted oldest-first past kMaxOpenSpans.
+  std::map<std::pair<int64_t, int64_t>, std::array<double, 4>> open_spans_;
 };
 
 // "host:port" -> connected TCP fd (blocking connect), or -1.

@@ -34,7 +34,9 @@ int main(int argc, char** argv) {
   std::string config_path, seed_hex, verifier_override, discovery, trace_path;
   int64_t id = -1;
   int metrics_every = 0;
+  int metrics_port = -1;
   int vc_timeout_ms = 0;
+  int verify_deadline_ms = -1;
   bool byzantine = false;
   for (int i = 1; i < argc; ++i) {
     std::string a = argv[i];
@@ -44,7 +46,9 @@ int main(int argc, char** argv) {
     else if (a == "--seed") seed_hex = next();
     else if (a == "--verifier") verifier_override = next();
     else if (a == "--metrics-every") metrics_every = std::atoi(next());
+    else if (a == "--metrics-port") metrics_port = std::atoi(next());
     else if (a == "--vc-timeout-ms") vc_timeout_ms = std::atoi(next());
+    else if (a == "--verify-deadline-ms") verify_deadline_ms = std::atoi(next());
     else if (a == "--discovery") discovery = next();
     else if (a == "--trace") trace_path = next();
     else if (a == "--byzantine") byzantine = true;
@@ -92,6 +96,11 @@ int main(int argc, char** argv) {
 
   pbft::ReplicaServer server(*cfg, id, seed, std::move(verifier));
   if (vc_timeout_ms > 0) server.set_view_change_timeout(vc_timeout_ms);
+  if (verify_deadline_ms >= 0) server.set_verify_deadline_ms(verify_deadline_ms);
+  // --metrics-port N: serve Prometheus text on 127.0.0.1:N (0 =
+  // ephemeral; the bound port is logged). Metric names match the Python
+  // runtime's --metrics-port (pbft_tpu/utils/trace_schema.py).
+  if (metrics_port >= 0) server.set_metrics_port(metrics_port);
   if (byzantine) server.set_byzantine(true);
   if (!discovery.empty()) server.enable_discovery(discovery);
   if (!trace_path.empty()) server.set_trace_file(trace_path);
@@ -105,6 +114,10 @@ int main(int argc, char** argv) {
   std::signal(SIGTERM, on_signal);
   std::fprintf(stderr, "pbftd replica %lld listening on %d (verifier=%s)\n",
                (long long)id, server.listen_port(), vsel.c_str());
+  if (server.metrics_listen_port() > 0) {
+    std::fprintf(stderr, "pbftd replica %lld metrics on 127.0.0.1:%d\n",
+                 (long long)id, server.metrics_listen_port());
+  }
 
   std::time_t last_metrics = std::time(nullptr);
   while (!server.stopped()) {

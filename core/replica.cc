@@ -88,6 +88,7 @@ Actions Replica::on_client_request(const ClientRequest& req) {
   }
   if (seq_counter_ + 1 > high_mark()) return out;  // window closed
   seq_counter_ += 1;
+  if (phase_hook) phase_hook("request", view_, seq_counter_);
   PrePrepare pp;
   pp.view = view_;
   pp.seq = seq_counter_;
@@ -204,6 +205,7 @@ Actions Replica::accept_pre_prepare(const PrePrepare& pp) {
   Key key{pp.view, pp.seq};
   pre_prepares_.emplace(key, pp);
   counters["pre_prepares_accepted"] += 1;
+  if (phase_hook) phase_hook("pre_prepare", pp.view, pp.seq);
   // The primary's pre-prepare stands in for its prepare (PBFT §4.2): only
   // backups multicast PREPARE, and prepared() wants 2f *backup* prepares,
   // giving 2f+1 distinct replicas per certificate.
@@ -254,6 +256,7 @@ bool Replica::prepared(const Key& key) const {
 Actions Replica::maybe_commit(const Key& key) {
   if (sent_commit_.count(key) || !prepared(key)) return {};
   sent_commit_.insert(key);
+  if (phase_hook) phase_hook("prepared", key.first, key.second);
   Commit cm;
   cm.view = key.first;
   cm.seq = key.second;
@@ -297,6 +300,7 @@ Actions Replica::maybe_execute(const Key& key) {
   int64_t seq = key.second;
   if (seq <= executed_upto_ || pending_execution_.count(seq)) return {};
   pending_execution_[seq] = {key.first, pre_prepares_.at(key).digest};
+  if (phase_hook) phase_hook("committed", key.first, seq);
   return drain_executions();
 }
 
@@ -309,10 +313,12 @@ Actions Replica::drain_executions() {
     auto ppit = pre_prepares_.find({view, seq});
     if (ppit == pre_prepares_.end()) {
       executed_upto_ = seq;  // truncated past us; needs state transfer
+      if (phase_hook) phase_hook("executed", view, seq);
       continue;
     }
     const ClientRequest& req = ppit->second.request;
     executed_upto_ = seq;
+    if (phase_hook) phase_hook("executed", view, seq);
     if (req.client == "<null>") {
       // Null request (view-change gap filler): no-op execution, no reply,
       // but the sequence and state digest chain still advance.

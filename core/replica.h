@@ -113,6 +113,15 @@ class Replica {
   // Metrics (SURVEY.md §5: first-class counters, not printf).
   std::map<std::string, int64_t> counters;
 
+  // Consensus-phase observer (mirrors pbft_tpu/consensus/replica.py
+  // phase_hook): called as hook(phase, view, seq) at each protocol
+  // transition — "request" (primary sequence assignment), "pre_prepare",
+  // "prepared", "committed", "executed". The state machine stays
+  // clock-free; the net layer stamps transitions into spans
+  // (net.cc on_phase -> Metrics histograms + consensus_span trace
+  // events). Unset costs one bool check per transition.
+  std::function<void(const char*, int64_t, int64_t)> phase_hook;
+
   // Optional stateful-app hooks (PBFT §5.3 state transfer). Defaults keep
   // the reference's no-op app ("awesome!", reference src/message.rs:70)
   // with an empty snapshot. A stateful app sets all three; its snapshot is

@@ -156,6 +156,19 @@ bool RemoteVerifier::begin_batch(const std::vector<VerifyItem>& items) {
   return true;
 }
 
+void RemoteVerifier::cancel_inflight() {
+  if (!inflight_) return;
+  // The wedge deadline fired: the connection may still be alive but the
+  // verdicts never came. Closing it is the only safe reset — partial
+  // verdict bytes already received would otherwise mis-pair with the
+  // next batch on the same stream.
+  if (fd_ >= 0) ::close(fd_);
+  fd_ = -1;
+  inflight_ = false;
+  resp_.clear();
+  expect_ = 0;
+}
+
 bool RemoteVerifier::poll_result(std::vector<uint8_t>* out, bool* failed) {
   *failed = false;
   if (!inflight_) {

@@ -54,6 +54,10 @@ class Verifier {
     *failed = true;
     return true;
   }
+  // Abandon an inflight async batch (the caller hit its wedge deadline,
+  // net.cc check_verify_deadline): drop the transport so a late reply
+  // lands on a closed socket instead of mis-pairing with the next batch.
+  virtual void cancel_inflight() {}
 };
 
 class CpuVerifier : public Verifier {
@@ -73,6 +77,7 @@ class RemoteVerifier : public Verifier {
   int async_fd() const override { return inflight_ ? fd_ : -1; }
   bool begin_batch(const std::vector<VerifyItem>& items) override;
   bool poll_result(std::vector<uint8_t>* out, bool* failed) override;
+  void cancel_inflight() override;
   // Test hook: adopt an already-connected fd (e.g. a socketpair end).
   void adopt_fd_for_test(int fd) { fd_ = fd; }
 

@@ -147,6 +147,13 @@ class Replica:
         self.view_changes: Dict[int, Dict[int, ViewChange]] = {}
         self.new_view_sent: Set[int] = set()
         self._inbox: List[Message] = []
+        # Consensus-phase observer (utils.metrics.ConsensusSpans.on_phase):
+        # called as hook(phase, view, seq) at each protocol transition. The
+        # state machine itself stays clock-free and deterministic — the
+        # hook only reports that a transition happened; the runtime stamps
+        # it. None (the default) costs one attribute check per transition,
+        # never per message (the Tracer discipline, utils/trace.py).
+        self.phase_hook: Optional[Callable[[str, int, int], None]] = None
         self.counters: Dict[str, int] = {
             "sig_verified": 0,
             "sig_rejected": 0,
@@ -203,6 +210,9 @@ class Replica:
             return []  # out of window until a checkpoint advances it
         self.seq_counter += 1
         n = self.seq_counter
+        hook = self.phase_hook
+        if hook is not None:  # primary-only: request -> sequence assignment
+            hook("request", self.view, n)
         pp = self._sign(
             PrePrepare(
                 view=self.view,
@@ -307,6 +317,9 @@ class Replica:
         key = (pp.view, pp.seq)
         self.pre_prepares[key] = pp
         self.counters["pre_prepares_accepted"] += 1
+        hook = self.phase_hook
+        if hook is not None:
+            hook("pre_prepare", pp.view, pp.seq)
         # The primary's pre-prepare stands in for its prepare (PBFT §4.2):
         # only backups multicast PREPARE, and _prepared wants 2f *backup*
         # prepares, giving 2f+1 distinct replicas per certificate.
@@ -356,6 +369,9 @@ class Replica:
         if key in self.sent_commit or not self._prepared(key):
             return []
         self.sent_commit.add(key)
+        hook = self.phase_hook
+        if hook is not None:
+            hook("prepared", key[0], key[1])
         pp = self.pre_prepares[key]
         cm = self._sign(
             Commit(view=key[0], seq=key[1], digest=pp.digest, replica=self.id)
@@ -398,6 +414,9 @@ class Replica:
         if seq <= self.executed_upto or seq in self.pending_execution:
             return []
         self.pending_execution[seq] = (view, self.pre_prepares[key].digest)
+        hook = self.phase_hook
+        if hook is not None:
+            hook("committed", view, seq)
         return self._drain_executions()
 
     def _drain_executions(self) -> List[Action]:
@@ -409,6 +428,9 @@ class Replica:
             seq = self.executed_upto + 1
             view, digest = self.pending_execution.pop(seq)
             self.executed_upto = seq
+            hook = self.phase_hook
+            if hook is not None:
+                hook("executed", view, seq)
             pp = self.pre_prepares.get((view, seq))
             if pp is None:
                 # Defensive: can only happen if the pre-prepare log lost an

@@ -36,7 +36,7 @@ from ..consensus.messages import (
     with_sig,
 )
 from ..consensus.replica import Broadcast, Replica, Reply, Send
-from ..utils import get_tracer
+from ..utils import ConsensusSpans, MetricsRegistry, get_tracer, start_metrics_server
 from . import secure
 
 
@@ -66,10 +66,31 @@ class AsyncReplicaServer:
         vc_timeout: float = 0.0,
         discovery: str = "",
         byzantine: bool = False,
+        metrics_port: Optional[int] = None,
     ):
         self.config = config
         self.id = replica_id
         self.replica = Replica(config, replica_id, seed)
+        # Metrics + consensus-phase spans (utils/metrics.py; names are the
+        # cross-runtime contract in utils/trace_schema.py). The registry is
+        # live whenever a scrape surface was asked for; spans additionally
+        # feed consensus_span trace events when tracing is on. With neither,
+        # phase_hook stays None — zero per-transition cost.
+        self.metrics_registry = MetricsRegistry(
+            labels={"replica": str(replica_id)}, enabled=metrics_port is not None
+        )
+        if self.metrics_registry.enabled:
+            self.metrics_registry.preregister()  # full replica series set
+        self.metrics_port = metrics_port
+        self._metrics_server = None
+        self.metrics_listen_port = 0
+        if self.metrics_registry.enabled or get_tracer().enabled:
+            self.spans = ConsensusSpans(
+                self.metrics_registry, tracer=get_tracer(), replica=replica_id
+            )
+            self.replica.phase_hook = self.spans.on_phase
+        else:
+            self.spans = None
         if callable(verifier):
             self.verify = verifier
         elif verifier == "jax":
@@ -151,6 +172,11 @@ class AsyncReplicaServer:
             self._discovery = await Discovery(
                 self.discovery_target, self.id, self.listen_port, self.config.n
             ).start()
+        if self.metrics_port is not None:
+            self._metrics_server = start_metrics_server(
+                self.metrics_registry, self.metrics_port
+            )
+            self.metrics_listen_port = self._metrics_server.server_address[1]
         asyncio.get_running_loop().create_task(self._batch_pump())
         if self.vc_timeout > 0:
             asyncio.get_running_loop().create_task(self._timer_loop())
@@ -159,6 +185,9 @@ class AsyncReplicaServer:
     async def stop(self) -> None:
         self._stopping = True
         self._batch_wakeup.set()
+        if self._metrics_server is not None:
+            self._metrics_server.shutdown()
+            self._metrics_server.server_close()
         if self._discovery:
             self._discovery.stop()
         if self._server:
@@ -303,6 +332,8 @@ class AsyncReplicaServer:
 
     def _ingest(self, msg: Message) -> None:
         self.frames_in += 1
+        if self.metrics_registry.enabled:
+            self.metrics_registry.counter("pbft_frames_in_total").inc()
         actions = self.replica.receive(msg)
         if actions:
             self._emit(actions)
@@ -336,10 +367,26 @@ class AsyncReplicaServer:
             if not items:
                 continue
             self.batches_run += 1
+            if self.metrics_registry.enabled:  # batch boundaries only, like tracing
+                self.metrics_registry.gauge("pbft_verify_queue_depth").set(len(items))
             # The JAX call blocks; run it off the event loop so sockets
             # keep draining into the next batch meanwhile.
             t0 = time.monotonic()
             verdicts = await loop.run_in_executor(None, self.verify, items)
+            secs = time.monotonic() - t0
+            if self.metrics_registry.enabled:
+                self.metrics_registry.counter("pbft_verify_batches_total").inc()
+                self.metrics_registry.counter("pbft_verify_items_total").inc(len(items))
+                self.metrics_registry.counter("pbft_verify_rejected_total").inc(
+                    verdicts.count(False)
+                )
+                self.metrics_registry.histogram("pbft_verify_batch_size").observe(len(items))
+                self.metrics_registry.histogram("pbft_verify_seconds").observe(secs)
+                # In-process verifier: the "inflight age" IS the last
+                # launch's round trip (mirrors the C++ async gauge).
+                self.metrics_registry.gauge("pbft_verify_inflight_age_seconds").set(
+                    round(secs, 6)
+                )
             tracer = get_tracer()
             if tracer.enabled:  # batch boundaries only — never per message
                 tracer.event(
@@ -347,7 +394,7 @@ class AsyncReplicaServer:
                     replica=self.id,
                     size=len(items),
                     rejected=verdicts.count(False),
-                    secs=round(time.monotonic() - t0, 6),
+                    secs=round(secs, 6),
                     view=self.replica.view,
                     executed=self.replica.executed_upto,
                 )
@@ -596,6 +643,8 @@ class AsyncReplicaServer:
                 self._timer_backoff = 1
             else:
                 self._timer_backoff = min(self._timer_backoff * 2, 64)
+                if self.metrics_registry.enabled:
+                    self.metrics_registry.counter("pbft_view_changes_total").inc()
                 get_tracer().event(
                     "view_change_start",
                     replica=self.id,
@@ -629,6 +678,7 @@ async def _amain(args) -> None:
         vc_timeout=args.vc_timeout_ms / 1000.0,
         discovery=args.discovery,
         byzantine=args.byzantine,
+        metrics_port=args.metrics_port,
     )
     await server.start()
     print(
@@ -653,6 +703,14 @@ def main() -> None:
     parser.add_argument("--verifier", default="cpu")
     parser.add_argument("--vc-timeout-ms", type=int, default=0)
     parser.add_argument("--metrics-every", type=int, default=0)
+    parser.add_argument(
+        "--metrics-port",
+        type=int,
+        default=None,
+        help="serve Prometheus text format on this port (0 = ephemeral); "
+        "metric names match pbftd --metrics-port so a mixed-runtime "
+        "cluster scrapes uniformly",
+    )
     parser.add_argument(
         "--discovery",
         default="",

@@ -89,6 +89,7 @@ class VerifierService:
         flush_items: int = 0,
         trace_path: Optional[str] = None,
         inflight: int = 1,
+        metrics_port: Optional[int] = None,
     ):
         if isinstance(backend, str):
             backend = {
@@ -120,6 +121,21 @@ class VerifierService:
         from ..utils.trace import Tracer
 
         self._tracer = Tracer(open(trace_path, "a") if trace_path else None)
+        # Metrics (utils/metrics.py; the verify subset of the cross-runtime
+        # contract in utils/trace_schema.py). Disabled unless a scrape
+        # surface was asked for — the dispatcher is the single writer.
+        from ..utils import MetricsRegistry, start_metrics_server
+
+        self.metrics_registry = MetricsRegistry(
+            labels={"replica": "service"}, enabled=metrics_port is not None
+        )
+        if self.metrics_registry.enabled:
+            self.metrics_registry.preregister("service.py")
+        self._metrics_server = None
+        self.metrics_listen_port = 0
+        if metrics_port is not None:
+            self._metrics_server = start_metrics_server(self.metrics_registry, metrics_port)
+            self.metrics_listen_port = self._metrics_server.server_address[1]
         self.batches = 0  # backend calls (XLA launches)
         self.requests = 0  # wire requests (>= batches when coalescing)
         self.items = 0
@@ -188,7 +204,19 @@ class VerifierService:
                 self.requests += 1
                 self.batches += 1
                 self.items += len(items)
-            return self._checked(self.backend, items)
+            t0 = time.monotonic()
+            verdicts = self._checked(self.backend, items)
+            if self.metrics_registry.enabled:
+                self.metrics_registry.counter("pbft_verify_batches_total").inc()
+                self.metrics_registry.counter("pbft_verify_items_total").inc(len(items))
+                self.metrics_registry.counter("pbft_verify_rejected_total").inc(
+                    verdicts.count(False)
+                )
+                self.metrics_registry.histogram("pbft_verify_batch_size").observe(len(items))
+                self.metrics_registry.histogram("pbft_verify_seconds").observe(
+                    time.monotonic() - t0
+                )
+            return verdicts
         p = _Pending(items)
         with self._cond:
             self.requests += 1
@@ -237,6 +265,10 @@ class VerifierService:
                         break
                     size += nxt
                     window.append(self._pending.pop(0))
+                if self.metrics_registry.enabled:  # items left queued past MAX_WINDOW
+                    self.metrics_registry.gauge("pbft_verify_queue_depth").set(
+                        sum(len(p.items) for p in self._pending)
+                    )
             self._inflight_sem.acquire()
             if self._inflight == 1:
                 self._dispatch_guarded(window)
@@ -309,6 +341,24 @@ class VerifierService:
         with self._cond:
             self.batches += 1
             self.items += len(merged)
+            if self.metrics_registry.enabled:
+                # Under the lock: with --inflight > 1 several launch
+                # threads finish concurrently (the replica runtimes'
+                # single-writer discipline doesn't hold here).
+                secs = time.monotonic() - t0
+                self.metrics_registry.counter("pbft_verify_batches_total").inc()
+                self.metrics_registry.counter("pbft_verify_items_total").inc(len(merged))
+                self.metrics_registry.histogram("pbft_verify_batch_size").observe(
+                    len(merged)
+                )
+                self.metrics_registry.histogram("pbft_verify_seconds").observe(secs)
+                self.metrics_registry.gauge("pbft_verify_inflight_age_seconds").set(
+                    round(secs, 6)
+                )
+                if verdicts is not None:
+                    self.metrics_registry.counter("pbft_verify_rejected_total").inc(
+                        verdicts.count(False)
+                    )
         if verdicts is None:
             for p in window:
                 t1 = time.monotonic()
@@ -358,6 +408,9 @@ class VerifierService:
         with self._cond:
             self._running = False
             self._cond.notify_all()
+        if self._metrics_server is not None:
+            self._metrics_server.shutdown()
+            self._metrics_server.server_close()
         self.server.shutdown()
         self.server.server_close()
         if self._thread:
@@ -414,6 +467,12 @@ def main() -> None:
         help="overlapped launches: ship window N+1 while N executes "
         "(hides host-side launch overhead; 1 = serial)",
     )
+    parser.add_argument(
+        "--metrics-port",
+        type=int,
+        default=None,
+        help="serve Prometheus text format on this port (0 = ephemeral)",
+    )
     args = parser.parse_args()
     svc = VerifierService(
         host=args.host,
@@ -424,6 +483,7 @@ def main() -> None:
         flush_items=args.flush_items,
         trace_path=args.trace,
         inflight=args.inflight,
+        metrics_port=args.metrics_port,
     )
     print(f"verifier service on {svc.address} backend={args.backend}", flush=True)
     svc.server.serve_forever()

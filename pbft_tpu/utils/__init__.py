@@ -1,11 +1,22 @@
-"""pbft_tpu.utils — structured logging / tracing.
+"""pbft_tpu.utils — structured logging / tracing / metrics.
 
 The reference's observability was ~110 println! calls, several inside the
 poll hot loop (SURVEY.md §5 — a real throughput hazard); here tracing is
 structured JSONL events behind a level check, off by default, and never
-in the per-signature hot path (batch boundaries only).
+in the per-signature hot path (batch boundaries only), and metrics are a
+Prometheus-style registry with the same one-attribute-check-when-disabled
+discipline (utils/metrics.py). Event/metric names are contracted across
+both runtimes by utils/trace_schema.py.
 """
 
+from .metrics import ConsensusSpans, MetricsRegistry, start_metrics_server
 from .trace import Tracer, get_tracer, set_trace_file
 
-__all__ = ["Tracer", "get_tracer", "set_trace_file"]
+__all__ = [
+    "ConsensusSpans",
+    "MetricsRegistry",
+    "Tracer",
+    "get_tracer",
+    "set_trace_file",
+    "start_metrics_server",
+]

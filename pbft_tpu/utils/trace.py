@@ -28,7 +28,10 @@ class Tracer:
             return
         rec = {"ts": round(time.monotonic(), 6), "ev": ev}
         rec.update(fields)
-        line = json.dumps(rec, separators=(",", ":")) + "\n"
+        # default=str: a non-JSON-serializable field value (a stray bytes
+        # digest, an enum, a numpy scalar) degrades to its str() form
+        # instead of throwing in the batching hot loop.
+        line = json.dumps(rec, separators=(",", ":"), default=str) + "\n"
         with self._lock:
             self.sink.write(line)
             self.sink.flush()

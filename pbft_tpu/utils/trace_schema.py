@@ -1,0 +1,115 @@
+"""THE schema manifest for trace events and metrics — single source of truth.
+
+Both runtimes (pbft_tpu/net/server.py + net/service.py in Python,
+core/net.cc in C++) emit JSONL trace events and Prometheus metrics whose
+names and field sets must stay identical, or a mixed-runtime cluster's
+traces stop merging and its scrapes stop aggregating. This module is the
+contract; scripts/check_trace_schema.py lints every emitter against it
+(wired into tier-1 via tests/test_trace_schema.py), and core/metrics.cc
+mirrors the metric table (checked by the same lint).
+
+Event schema entries:
+    required  fields every event of this name must carry
+    optional  fields an emitter may add
+    emitters  the source files allowed to emit this event name
+
+Changing an event or metric here without updating every emitter (or vice
+versa) fails the lint — that is the point.
+"""
+
+from __future__ import annotations
+
+# -- trace events (JSONL lines: {"ts": .., "ev": <name>, ...fields}) --------
+
+EVENT_SCHEMAS = {
+    "verify_batch": {
+        "required": {"ts", "ev", "replica", "size", "rejected", "secs"},
+        "optional": {"view", "executed", "requests"},
+        "emitters": {"server.py", "service.py", "net.cc"},
+    },
+    "verify_window_failed": {
+        "required": {"ts", "ev", "replica", "size", "requests", "rejected", "secs"},
+        "optional": set(),
+        "emitters": {"service.py"},
+    },
+    "verify_batch_error": {
+        "required": {"ts", "ev", "replica", "size", "secs"},
+        "optional": set(),
+        "emitters": {"service.py"},
+    },
+    "view_change_start": {
+        "required": {"ts", "ev", "replica", "pending_view", "backoff"},
+        "optional": set(),
+        "emitters": {"server.py", "net.cc"},
+    },
+    # One span per executed (view, seq): absolute monotonic stamps for each
+    # consensus phase this replica observed. "request" is primary-only (a
+    # backup's first sighting is the pre-prepare); stamps are comparable
+    # across processes on one host (CLOCK_MONOTONIC is per-boot).
+    "consensus_span": {
+        "required": {"ts", "ev", "replica", "view", "seq", "pre_prepare", "executed"},
+        "optional": {"request", "prepared", "committed"},
+        "emitters": {"server.py", "net.cc"},
+    },
+    # The wedged-async-verifier bound (ADVICE.md core/net.cc item): the
+    # inflight launch overran its deadline, the connection was dropped and
+    # the batch re-verified on the CPU safety net.
+    "verify_deadline_fired": {
+        "required": {"ts", "ev", "replica", "size", "age_secs"},
+        "optional": set(),
+        "emitters": {"net.cc"},
+    },
+}
+
+# -- metrics (Prometheus text format at --metrics-port) ---------------------
+#
+# name -> (type, emitters). Replica runtimes (server.py, net.cc) must emit
+# the full replica set with IDENTICAL names so a mixed-runtime cluster
+# scrapes uniformly; the verifier service emits the verify subset.
+
+METRIC_SCHEMAS = {
+    "pbft_frames_in_total": ("counter", {"server.py", "net.cc"}),
+    "pbft_executed_total": ("counter", {"server.py", "net.cc"}),
+    "pbft_view_changes_total": ("counter", {"server.py", "net.cc"}),
+    "pbft_verify_batches_total": ("counter", {"server.py", "service.py", "net.cc"}),
+    "pbft_verify_items_total": ("counter", {"server.py", "service.py", "net.cc"}),
+    "pbft_verify_rejected_total": ("counter", {"server.py", "service.py", "net.cc"}),
+    "pbft_verify_deadline_fired_total": ("counter", {"net.cc"}),
+    "pbft_verify_queue_depth": ("gauge", {"server.py", "service.py", "net.cc"}),
+    "pbft_verify_inflight_age_seconds": ("gauge", {"server.py", "service.py", "net.cc"}),
+    "pbft_verify_batch_size": ("histogram", {"server.py", "service.py", "net.cc"}),
+    "pbft_verify_seconds": ("histogram", {"server.py", "service.py", "net.cc"}),
+    "pbft_phase_pre_prepare_seconds": ("histogram", {"server.py", "net.cc"}),
+    "pbft_phase_prepare_seconds": ("histogram", {"server.py", "net.cc"}),
+    "pbft_phase_commit_seconds": ("histogram", {"server.py", "net.cc"}),
+    "pbft_phase_reply_seconds": ("histogram", {"server.py", "net.cc"}),
+    "pbft_request_reply_seconds": ("histogram", {"server.py", "net.cc"}),
+}
+
+# Fixed histogram bucket upper edges (le semantics: v <= edge). Shared by
+# both runtimes — core/metrics.cc mirrors these values; the lint compares.
+LATENCY_BUCKETS_S = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+    0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+BATCH_SIZE_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096)
+
+# The consensus phases in protocol order. "request" exists only on the
+# primary (it assigns the sequence number); every replica sees the rest.
+PHASES = ("request", "pre_prepare", "prepared", "committed", "executed")
+
+# phase-transition -> the latency histogram it feeds (observed at
+# "executed" time from the span's stamps).
+PHASE_HISTOGRAMS = {
+    ("request", "pre_prepare"): "pbft_phase_pre_prepare_seconds",
+    ("pre_prepare", "prepared"): "pbft_phase_prepare_seconds",
+    ("prepared", "committed"): "pbft_phase_commit_seconds",
+    ("committed", "executed"): "pbft_phase_reply_seconds",
+}
+
+
+def histogram_buckets(name: str):
+    """The fixed bucket edges for a manifest histogram."""
+    if METRIC_SCHEMAS[name][0] != "histogram":
+        raise ValueError(f"{name} is not a histogram")
+    return BATCH_SIZE_BUCKETS if name == "pbft_verify_batch_size" else LATENCY_BUCKETS_S

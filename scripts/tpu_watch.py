@@ -145,13 +145,17 @@ def git_commit(tag: str) -> None:
             cas = git(["update-ref", "HEAD", new, head])
             if cas.returncode == 0:
                 log(f"git: committed capture artifacts ({new[:12]})")
-                # Resync the SHARED index for the committed paths: it is
-                # now stale vs the new HEAD, which would read as staged
+                # Resync the SHARED index for the committed paths ONLY: it
+                # is now stale vs the new HEAD, which would read as staged
                 # deletions to the builder (and a `git commit -a` there
-                # could really delete them). Staging files identical to
-                # HEAD is a no-op state — safe even mid-builder-workflow.
+                # could really delete them). Restricted to the exact files
+                # this commit touched — a blanket `add -A -- benchmarks`
+                # would clobber anything the concurrent builder session
+                # had deliberately staged under benchmarks/ (ADVICE.md).
+                diff = git(["diff", "--name-only", head, new])
+                paths = [p for p in diff.stdout.splitlines() if p.strip()]
                 for _ in range(3):
-                    if git(["add", "-A", "--", "benchmarks"]).returncode == 0:
+                    if not paths or git(["add", "--"] + paths).returncode == 0:
                         break
                     time.sleep(2)
                 return

@@ -36,8 +36,54 @@ def load(path: pathlib.Path):
     return events
 
 
+# The consensus_span phase chain (utils/trace_schema.py): per-transition
+# latencies reported as p50/p90 when a trace carries span events.
+_SPAN_PHASES = [
+    ("request", "pre_prepare"),
+    ("pre_prepare", "prepared"),
+    ("prepared", "committed"),
+    ("committed", "executed"),
+]
+
+
+def _span_summary(spans) -> str:
+    """One-line per-phase latency summary for consensus_span events."""
+    parts = []
+    for a, b in _SPAN_PHASES:
+        durs = sorted(
+            e[b] - e[a]
+            for e in spans
+            if isinstance(e.get(a), (int, float))
+            and isinstance(e.get(b), (int, float))
+        )
+        if durs:
+            parts.append(
+                f"{b} p50={_pct(durs, 0.5) * 1e3:.2f}ms "
+                f"p90={_pct(durs, 0.9) * 1e3:.2f}ms"
+            )
+    e2e = sorted(
+        e["executed"] - (e.get("request", e.get("pre_prepare")))
+        for e in spans
+        if isinstance(e.get("executed"), (int, float))
+        and isinstance(e.get("request", e.get("pre_prepare")), (int, float))
+    )
+    if e2e:
+        parts.append(
+            f"e2e p50={_pct(e2e, 0.5) * 1e3:.2f}ms "
+            f"p90={_pct(e2e, 0.9) * 1e3:.2f}ms"
+        )
+    return ", ".join(parts)
+
+
 def report(files) -> dict:
-    total = {"batches": 0, "items": 0, "rejected": 0, "secs": 0.0, "vcs": 0}
+    total = {
+        "batches": 0,
+        "items": 0,
+        "rejected": 0,
+        "secs": 0.0,
+        "vcs": 0,
+        "spans": 0,
+    }
     for path in files:
         events = load(path)
         vb = [e for e in events if e.get("ev") == "verify_batch"]
@@ -50,6 +96,15 @@ def report(files) -> dict:
         # Both runtimes emit "view_change_start" (core/net.cc
         # trace_view_change, server.py _timer_loop).
         vcs = [e for e in events if e.get("ev") == "view_change_start"]
+        spans = [e for e in events if e.get("ev") == "consensus_span"]
+        deadline_fired = [
+            e for e in events if e.get("ev") == "verify_deadline_fired"
+        ]
+        if deadline_fired:
+            print(
+                f"{path.name}: {len(deadline_fired)} verify deadlines fired "
+                "(wedged async verifier -> CPU safety net)"
+            )
         sizes = sorted(e["size"] for e in vb)
         secs = sorted(e["secs"] for e in vb)
         rejected = sum(e.get("rejected", 0) for e in vb)
@@ -58,6 +113,10 @@ def report(files) -> dict:
         total["rejected"] += rejected
         total["secs"] += sum(secs)
         total["vcs"] += len(vcs)
+        total["spans"] += len(spans)
+        if spans:
+            print(f"{path.name}: {len(spans)} consensus spans: "
+                  + _span_summary(spans))
         if vb:
             span = vb[-1]["ts"] - vb[0]["ts"] or 1e-9
             print(
@@ -77,6 +136,11 @@ def report(files) -> dict:
             f"(batching-window efficiency), {total['rejected']} rejected, "
             f"{total['vcs']} view changes, "
             f"{total['secs']:.2f}s total verify time"
+        )
+    if total["spans"]:
+        print(
+            f"cluster: {total['spans']} consensus spans "
+            "(per-(view,seq) breakdowns: scripts/consensus_timeline.py)"
         )
     return total
 
