@@ -71,7 +71,12 @@ def _log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-def _emit(per_sec: float, backend: str, note: str | None = None) -> None:
+def _emit(
+    per_sec: float,
+    backend: str,
+    note: str | None = None,
+    extra: dict | None = None,
+) -> None:
     result = {
         "metric": _METRIC,
         "value": round(per_sec, 1),
@@ -79,6 +84,8 @@ def _emit(per_sec: float, backend: str, note: str | None = None) -> None:
         "vs_baseline": round(per_sec / 50_000.0, 3),
         "backend": backend,
     }
+    if extra:
+        result.update(extra)
     if note:
         result["note"] = note
     print(json.dumps(result))
@@ -115,7 +122,9 @@ def _force_cpu() -> None:
         _log(f"cpu forcing incomplete: {e}")
 
 
-def _probe_tpu(timeout_s: float, attempts: int, gap_s: float) -> bool:
+def _probe_tpu(
+    timeout_s: float, attempts: int, gap_s: float, budget_s: float | None = None
+) -> bool:
     """Probe TPU backend init in disposable subprocesses.
 
     A wedged tunnel hangs ``jax.devices()`` beyond any in-process watchdog's
@@ -123,22 +132,43 @@ def _probe_tpu(timeout_s: float, attempts: int, gap_s: float) -> bool:
     attempt just queues behind the same wedged client init). Subprocesses
     are killable, and a tunnel that is merely slow/mid-restart often comes
     back between attempts.
+
+    ``budget_s`` caps the WHOLE probe loop (attempts + backoff gaps): the
+    BENCH_r05 lesson was 8 x 60 s of probing before the inevitable CPU
+    fallback — a dead tunnel should cost minutes, not the round's budget.
     """
     import subprocess
 
     code = "import jax; d = jax.devices(); print(len(d), d[0].platform)"
     gap = gap_s
+    loop_t0 = time.perf_counter()
     for attempt in range(1, attempts + 1):
+        if budget_s is not None:
+            spent = time.perf_counter() - loop_t0
+            if spent >= budget_s:
+                _log(
+                    f"tpu probe: budget {budget_s:.0f}s exhausted after "
+                    f"{attempt - 1} attempts ({spent:.0f}s)"
+                )
+                return False
         t0 = time.perf_counter()
+        attempt_timeout = timeout_s
+        if budget_s is not None:
+            attempt_timeout = min(
+                timeout_s, max(5.0, budget_s - (time.perf_counter() - loop_t0))
+            )
         try:
             out = subprocess.run(
                 [sys.executable, "-c", code],
                 capture_output=True,
                 text=True,
-                timeout=timeout_s,
+                timeout=attempt_timeout,
             )
         except subprocess.TimeoutExpired:
-            _log(f"tpu probe {attempt}/{attempts}: timeout after {timeout_s}s")
+            _log(
+                f"tpu probe {attempt}/{attempts}: timeout after "
+                f"{attempt_timeout:.0f}s"
+            )
             out = None
         if out is not None and out.returncode == 0:
             info = out.stdout.strip()
@@ -245,24 +275,9 @@ def _signed_pool(batch: int):
     return bp, bm, bs
 
 
-def _native_fallback(target_secs: float, reason: str) -> bool:
-    """Measure the framework's production CPU verifier arm (the native C++
-    backend pbftd uses) — no JAX involvement at all. Returns False if the
-    native core isn't available (caller then tries XLA:CPU)."""
-    native = _native_mod()
-    if native is None:
-        return False
-    # Same batch as the TPU arm. The spec corrupts one signature per
-    # window (below), which makes every window pay the RLC bisect; that
-    # fixed bisect cost amortizes over the batch, so the ONE-BAD rate
-    # roughly doubles from 1024 to 4096 (8.3k -> 17.1k in one
-    # same-window measurement) while the honest rate is ~17k at either.
-    batch = int(os.environ.get("PBFT_BENCH_BATCH", "4096"))
-    bp, bm, bs = _signed_pool(batch)
-    items = [(bytes(bp[i]), bytes(bm[i]), bytes(bs[i])) for i in range(batch)]
-    out = native.verify_batch(items)
-    if sum(out) != batch - 1 or out[batch // 2]:
-        _fail("native-verdicts", f"wrong bitmap: sum={sum(out)}")
+def _native_rate(native, items, target_secs: float) -> float:
+    """Sustained verifies/sec over repeated full-batch calls."""
+    batch = len(items)
     done = 0
     t0 = time.perf_counter()
     elapsed = 0.0
@@ -270,9 +285,60 @@ def _native_fallback(target_secs: float, reason: str) -> bool:
         native.verify_batch(items)
         done += batch
         elapsed = time.perf_counter() - t0
-    per_sec = done / elapsed
-    _log(f"native CPU arm: {done} verifies in {elapsed:.2f}s")
-    _emit(per_sec, "cpu-native-fallback", reason)
+    return done / elapsed
+
+
+def _native_fallback(
+    target_secs: float, reason: str | None, backend: str = "cpu-native-fallback"
+) -> bool:
+    """Measure the framework's production CPU verifier arm (the native C++
+    backend pbftd uses) — no JAX involvement at all. Measures BOTH the
+    single-thread rate and the pooled rate (core/verify_pool.cc at
+    PBFT_VERIFY_THREADS, default hardware concurrency) and reports the
+    pooled number as the headline with the scaling recorded alongside.
+    Returns False if the native core isn't available (caller then tries
+    XLA:CPU)."""
+    native = _native_mod()
+    if native is None:
+        return False
+    # Same batch as the TPU arm. The spec corrupts one signature per
+    # batch (below), so exactly one RLC window pays the bisect; the fixed
+    # bisect cost amortizes over the batch.
+    batch = int(os.environ.get("PBFT_BENCH_BATCH", "4096"))
+    bp, bm, bs = _signed_pool(batch)
+    items = [(bytes(bp[i]), bytes(bm[i]), bytes(bs[i])) for i in range(batch)]
+    out = native.verify_batch(items)
+    if sum(out) != batch - 1 or out[batch // 2]:
+        _fail("native-verdicts", f"wrong bitmap: sum={sum(out)}")
+    want_threads = int(os.environ.get("PBFT_VERIFY_THREADS", "0"))
+    native.set_verify_threads(1)
+    single = _native_rate(native, items, max(1.0, target_secs / 2))
+    _log(f"native CPU arm (1 thread): {single:.0f} verifies/sec")
+    native.set_verify_threads(want_threads)  # 0 = hardware concurrency
+    threads = native.verify_threads()
+    if threads > 1:
+        # Pooled/serial verdict parity on the bench batch itself before
+        # trusting the pooled rate.
+        if native.verify_batch(items) != out:
+            _fail("native-verdicts", "pooled verdicts diverge from serial")
+        pooled = _native_rate(native, items, target_secs)
+    else:
+        pooled = single
+    _log(
+        f"native CPU arm: {pooled:.0f} verifies/sec pooled "
+        f"({threads} threads; {pooled / single:.2f}x single-thread)"
+    )
+    _emit(
+        pooled,
+        backend,
+        reason,
+        extra={
+            "threads": threads,
+            "single_thread_per_sec": round(single, 1),
+            "pooled_per_sec": round(pooled, 1),
+            "pool_speedup": round(pooled / single, 2),
+        },
+    )
     return True
 
 
@@ -328,6 +394,12 @@ def main() -> None:
     if "--tpu-worker" in sys.argv:
         _run_xla_bench("tpu", None, target_secs)
         return
+    if os.environ.get("PBFT_BENCH_NATIVE"):
+        # Direct native-arm run (no TPU probing): the pooled C++ verifier,
+        # reported as "cpu-native" with threads + single-vs-pooled rates.
+        if not _native_fallback(target_secs, None, backend="cpu-native"):
+            _fail("native", "native core unavailable")
+        return
     if os.environ.get("PBFT_BENCH_CPU") or os.environ.get("JAX_PLATFORMS") == "cpu":
         os.environ["JAX_PLATFORMS"] = "cpu"
         _force_cpu()
@@ -336,10 +408,14 @@ def main() -> None:
 
     # TPU path: probe in disposable subprocesses, then run the bench in a
     # killable worker; retry (with a short re-probe) if the worker wedges.
+    # PBFT_TPU_PROBE_BUDGET_S caps the whole probe loop (BENCH_r05 burned
+    # 8 x 60 s before the inevitable fallback).
+    probe_budget = float(os.environ.get("PBFT_TPU_PROBE_BUDGET_S", "240"))
     probed = _probe_tpu(
         timeout_s=float(os.environ.get("PBFT_BENCH_PROBE_TIMEOUT", "60")),
         attempts=int(os.environ.get("PBFT_BENCH_PROBES", "8")),
         gap_s=float(os.environ.get("PBFT_BENCH_PROBE_GAP", "10")),
+        budget_s=probe_budget,
     )
     if probed:
         worker_timeout = float(os.environ.get("PBFT_BENCH_WORKER_TIMEOUT", "600"))
@@ -358,7 +434,8 @@ def main() -> None:
             if result is not None and not err.startswith("backend-init"):
                 break
             if attempt < tpu_attempts and not _probe_tpu(
-                timeout_s=60.0, attempts=3, gap_s=15.0
+                timeout_s=60.0, attempts=3, gap_s=15.0,
+                budget_s=min(90.0, probe_budget),
             ):
                 break
     fallback_reason = "tpu bench never completed; CPU fallback"

@@ -19,10 +19,12 @@
 #include "ed25519.h"
 #include "json.h"
 #include "messages.h"
+#include "metrics.h"
 #include "replica.h"
 #include "secure.h"
 #include "sha512.h"
 #include "verifier.h"
+#include "verify_pool.h"
 
 namespace {
 
@@ -446,6 +448,69 @@ void test_batch_verify_rlc() {
   }
 }
 
+void test_verify_pool_native() {
+  // Pool lifecycle: construct/verify/destroy across widths (ASAN-friendly:
+  // every worker joins in the destructor, no sleeps), pooled verdicts
+  // identical to the serial path, stats accounting, and the entropy-
+  // exhaustion fallback (RLC disabled -> per-item, honest items still
+  // accepted).
+  const size_t n = (size_t)pbft::kEd25519RlcWindowItems + 40;
+  std::vector<uint8_t> pubs(32 * n), msgs(32 * n), sigs(64 * n);
+  for (size_t i = 0; i < n; ++i) {
+    uint8_t seed[32];
+    std::memset(seed, (int)(i % 250 + 1), 32);
+    std::memset(msgs.data() + 32 * i, (int)(0xA0 ^ (i & 0xFF)), 32);
+    pbft::ed25519_public_key(pubs.data() + 32 * i, seed);
+    pbft::ed25519_sign(sigs.data() + 64 * i, seed, msgs.data() + 32 * i, 32);
+  }
+  // Corruption at both sides of the window boundary and in each window.
+  std::set<size_t> bad = {0, pbft::kEd25519RlcWindowItems - 1,
+                          pbft::kEd25519RlcWindowItems, n - 1, 17};
+  for (size_t i : bad) sigs[64 * i + 40] ^= 0x5A;
+  std::vector<uint8_t> serial(n);
+  pbft::ed25519_verify_batch(pubs.data(), msgs.data(), sigs.data(), n,
+                             serial.data());
+  for (size_t i = 0; i < n; ++i) CHECK(serial[i] == (bad.count(i) ? 0 : 1));
+  for (int threads : {1, 2, 3}) {
+    pbft::VerifyPool pool(threads);
+    CHECK(pool.threads() == threads);
+    std::vector<uint8_t> out(n);
+    pool.verify(pubs.data(), msgs.data(), sigs.data(), n, out.data());
+    CHECK(out == serial);
+    auto s = pool.stats();
+    CHECK(s.threads == threads);
+    CHECK(s.batches == 1 && s.windows == 2 && s.items == (int64_t)n);
+    CHECK(s.wall_seconds > 0 && s.busy_seconds > 0);
+    CHECK(s.last_window_items == (int64_t)pbft::kEd25519RlcWindowItems);
+  }
+  // Entropy exhaustion: fast path off, honest items still verify.
+  pbft::ed25519_test_force_entropy_exhaustion(true);
+  std::vector<uint8_t> out(n);
+  pbft::VerifyPool pool(2);
+  pool.verify(pubs.data(), msgs.data(), sigs.data(), n, out.data());
+  pbft::ed25519_test_force_entropy_exhaustion(false);
+  CHECK(out == serial);
+  // Metrics export: the pool gauges/histogram are registered and render
+  // under the manifest names (schema parity with trace_schema.py).
+  pbft::Metrics m;
+  m.enabled = true;
+  m.set_gauge("pbft_verify_pool_threads", 2);
+  m.set_gauge("pbft_verify_pool_queue_depth", 2);
+  m.set_gauge("pbft_verify_pool_utilization", 0.5);
+  m.observe("pbft_verify_pool_window_size", 256);
+  std::string text = m.render_prometheus("0");
+  CHECK(text.find("# TYPE pbft_verify_pool_threads gauge") !=
+        std::string::npos);
+  CHECK(text.find("pbft_verify_pool_threads{replica=\"0\"} 2") !=
+        std::string::npos);
+  CHECK(text.find("pbft_verify_pool_utilization{replica=\"0\"} 0.5") !=
+        std::string::npos);
+  CHECK(text.find("pbft_verify_pool_window_size_bucket{replica=\"0\","
+                  "le=\"256\"} 1") != std::string::npos);
+  CHECK(text.find("pbft_verify_pool_window_size_count{replica=\"0\"} 1") !=
+        std::string::npos);
+}
+
 void test_remote_verifier_async() {
   // Drive the async verifier protocol against a socketpair standing in
   // for the service: request framing, partial-verdict reads, and the
@@ -522,6 +587,7 @@ int main() {
   test_stable_digest_majority_native();
   test_state_transfer_native();
   test_batch_verify_rlc();
+  test_verify_pool_native();
   test_remote_verifier_async();
   if (g_failures) {
     std::fprintf(stderr, "%d failure(s)\n", g_failures);

@@ -630,7 +630,11 @@ bool ed25519_verify(const uint8_t pub[32], const uint8_t* msg, size_t msglen,
 // ---------------------------------------------------------------------------
 // Batch verification: random-linear-combination check + Pippenger MSM.
 //
-// A window of n signatures is checked as
+// A batch is split into FIXED windows of kEd25519RlcWindowItems — the
+// window composition depends only on item order, so the serial loop here
+// and the parallel per-window dispatch in core/verify_pool.cc produce the
+// same accept set at every thread count. A window of n signatures is
+// checked as
 //     [sum z_i S_i] B  ==  sum [z_i] R_i + sum [z_i h_i] A_i
 // with fresh random 128-bit z_i. All honest windows pass with one
 // multi-scalar multiplication over 2n points — asymptotically ~253/w
@@ -651,11 +655,27 @@ bool ed25519_verify(const uint8_t pub[32], const uint8_t* msg, size_t msglen,
 // nothing new: a Byzantine signer can already produce per-replica
 // disagreement by sending different bytes to different replicas
 // (equivocation), which PBFT's quorum intersection tolerates by design.
+//
+// Entropy exhaustion: if no entropy source answers, the RLC fast path is
+// DISABLED and the window verifies per-item (predictable z_i would let a
+// crafted cancelling-defect pair pass the combination — ADVICE round-5).
 // ---------------------------------------------------------------------------
 
 namespace {
 
-void batch_coeffs_random(uint8_t* buf, size_t n) {
+std::atomic<bool> g_force_entropy_exhaustion{false};
+
+// Fill buf with n random bytes for RLC coefficients. Returns false when
+// no entropy source answers (ADVICE round-5 medium): the old last-resort
+// — a per-process counter hashed through SHA-512 — was PREDICTABLE, and
+// an attacker who predicts z_i can craft two invalid signatures with
+// cancelling non-torsion defects that pass the RLC check without the
+// bisect ever running. On failure the caller must disable the fast path
+// and verify the window per-item (core/secure.cc fill_random treats the
+// same condition as fatal; verification has a sound slow path, so it
+// degrades instead).
+bool batch_coeffs_random(uint8_t* buf, size_t n) {
+  if (g_force_entropy_exhaustion.load(std::memory_order_relaxed)) return false;
   size_t off = 0;
   int failures = 0;
   while (off < n) {
@@ -665,31 +685,16 @@ void batch_coeffs_random(uint8_t* buf, size_t n) {
       continue;
     }
     // getrandom unavailable/interrupted: /dev/urandom next (same tiering
-    // as core/secure.cc fill_random) before the last-resort counter.
+    // as core/secure.cc fill_random).
     if (FILE* f = std::fopen("/dev/urandom", "rb")) {
       size_t got = std::fread(buf + off, 1, n - off, f);
       std::fclose(f);
       off += got;
       if (got > 0) continue;
     }
-    if (++failures > 16) {
-      // No entropy: fall back to a per-process counter hashed through
-      // SHA-512. Predictable z_i only weaken the 2^-125 soundness of the
-      // *fast path* against non-torsion forgeries; any such forgery
-      // still fails the bisected per-item verify, so correctness holds.
-      static std::atomic<uint64_t> ctr{0};
-      uint8_t h[64];
-      for (size_t i = off; i < n; i += 32) {
-        uint8_t seed[16];
-        uint64_t c = ++ctr;
-        std::memcpy(seed, &c, 8);
-        std::memset(seed + 8, 0xB5, 8);
-        sha512(h, seed, 16);
-        std::memcpy(buf + i, h, n - i < 32 ? n - i : 32);
-      }
-      return;
-    }
+    if (++failures > 16) return false;
   }
+  return true;
 }
 
 // Pippenger bucket MSM: sum [scalars[i]] pts[i], scalars 4-limb < L.
@@ -763,13 +768,28 @@ bool ge_points_equal(const ge& p, const ge& q) {
   return std::memcmp(ep, eq, 32) == 0;
 }
 
+// Per-item slow path over prepared items — the authority for every
+// rejection, and the whole path when entropy is unavailable.
+void verify_prepared_per_item(const std::vector<BatchPrep>& prep,
+                              const std::vector<size_t>& idx, uint8_t* out) {
+  for (size_t i : idx) {
+    const BatchPrep& it = prep[i];
+    ge p = double_scalar_mult(it.s, ge_neg(it.a), it.h);
+    out[i] = ge_points_equal(p, it.r) ? 1 : 0;
+  }
+}
+
+enum class RlcResult { kPass, kFail, kNoEntropy };
+
 // One RLC check over the subset `idx` of prepared items; fresh z_i per
 // call (bisect recursion re-randomizes).
-bool rlc_check(const std::vector<BatchPrep>& prep,
-               const std::vector<size_t>& idx) {
+RlcResult rlc_check(const std::vector<BatchPrep>& prep,
+                    const std::vector<size_t>& idx) {
   const size_t n = idx.size();
   std::vector<uint8_t> rnd(16 * n);
-  batch_coeffs_random(rnd.data(), rnd.size());
+  if (!batch_coeffs_random(rnd.data(), rnd.size())) {
+    return RlcResult::kNoEntropy;
+  }
   std::vector<ge> pts;
   std::vector<std::array<u64, 4>> scalars;
   pts.reserve(2 * n);
@@ -790,7 +810,9 @@ bool rlc_check(const std::vector<BatchPrep>& prep,
     pts.push_back(it.a);
     scalars.push_back({zh[0], zh[1], zh[2], zh[3]});
   }
-  return ge_points_equal(scalar_mult_base(sb), msm_pippenger(pts, scalars));
+  return ge_points_equal(scalar_mult_base(sb), msm_pippenger(pts, scalars))
+             ? RlcResult::kPass
+             : RlcResult::kFail;
 }
 
 void batch_bisect(const std::vector<BatchPrep>& prep,
@@ -800,16 +822,20 @@ void batch_bisect(const std::vector<BatchPrep>& prep,
   // from a canonical encoding, so point equality == the byte compare
   // ed25519_verify does).
   if (idx.size() < 8) {
-    for (size_t i : idx) {
-      const BatchPrep& it = prep[i];
-      ge p = double_scalar_mult(it.s, ge_neg(it.a), it.h);
-      out[i] = ge_points_equal(p, it.r) ? 1 : 0;
-    }
+    verify_prepared_per_item(prep, idx, out);
     return;
   }
-  if (rlc_check(prep, idx)) {
-    for (size_t i : idx) out[i] = 1;
-    return;
+  switch (rlc_check(prep, idx)) {
+    case RlcResult::kPass:
+      for (size_t i : idx) out[i] = 1;
+      return;
+    case RlcResult::kNoEntropy:
+      // No unpredictable coefficients: the fast path is unsound (see
+      // batch_coeffs_random). Per-item verification needs no randomness.
+      verify_prepared_per_item(prep, idx, out);
+      return;
+    case RlcResult::kFail:
+      break;
   }
   std::vector<size_t> lo(idx.begin(), idx.begin() + idx.size() / 2);
   std::vector<size_t> hi(idx.begin() + idx.size() / 2, idx.end());
@@ -819,8 +845,12 @@ void batch_bisect(const std::vector<BatchPrep>& prep,
 
 }  // namespace
 
-void ed25519_verify_batch(const uint8_t* pubs, const uint8_t* msgs,
-                          const uint8_t* sigs, size_t n, uint8_t* out) {
+void ed25519_test_force_entropy_exhaustion(bool on) {
+  g_force_entropy_exhaustion.store(on, std::memory_order_relaxed);
+}
+
+void ed25519_verify_window(const uint8_t* pubs, const uint8_t* msgs,
+                           const uint8_t* sigs, size_t n, uint8_t* out) {
   if (n < 8) {
     // Below the RLC crossover the independent ladders win — and the
     // prep work (two decompressions + the hash per item) would only be
@@ -835,9 +865,17 @@ void ed25519_verify_batch(const uint8_t* pubs, const uint8_t* msgs,
   std::vector<BatchPrep> prep(n);
   std::vector<size_t> live;
   live.reserve(n);
+  // Pipelined prep: one pass of pure SHA-512 hashing first (sequential,
+  // branch-light, keeps the compression function hot in I-cache), then a
+  // pass of point decompressions + scalar pre-checks. The split costs
+  // nothing on the honest path and lets each loop stay in its own
+  // working set instead of ping-ponging between hash and field code.
+  for (size_t i = 0; i < n; ++i) {
+    out[i] = 0;
+    hash_to_scalar(prep[i].h, sigs + 64 * i, pubs + 32 * i, msgs + 32 * i, 32);
+  }
   for (size_t i = 0; i < n; ++i) {
     BatchPrep& it = prep[i];
-    out[i] = 0;
     if (!ge_decompress(&it.a, pubs + 32 * i)) continue;
     // R must be a canonical curve-point encoding: the per-item check
     // compares encode([S]B - [h]A) against the R bytes, and encode()
@@ -846,10 +884,19 @@ void ed25519_verify_batch(const uint8_t* pubs, const uint8_t* msgs,
     if (!ge_decompress(&it.r, sigs + 64 * i)) continue;
     sc_from_bytes(it.s, sigs + 64 * i + 32);
     if (!sc_lt_l(it.s)) continue;
-    hash_to_scalar(it.h, sigs + 64 * i, pubs + 32 * i, msgs + 32 * i, 32);
     live.push_back(i);
   }
   batch_bisect(prep, live, out);
+}
+
+void ed25519_verify_batch(const uint8_t* pubs, const uint8_t* msgs,
+                          const uint8_t* sigs, size_t n, uint8_t* out) {
+  for (size_t off = 0; off < n; off += kEd25519RlcWindowItems) {
+    size_t w = n - off < kEd25519RlcWindowItems ? n - off
+                                                : kEd25519RlcWindowItems;
+    ed25519_verify_window(pubs + 32 * off, msgs + 32 * off, sigs + 64 * off,
+                          w, out + off);
+  }
 }
 
 }  // namespace pbft

@@ -34,8 +34,30 @@ bool ed25519_verify(const uint8_t pub[32], const uint8_t* msg, size_t msglen,
 // the authority for every rejection). ~2-4x the per-item throughput on
 // honest windows; see the accept-set note in ed25519.cc. Inputs are
 // packed arrays (pubs: n*32, msgs: n*32, sigs: n*64); out: n bytes 0/1.
+//
+// The batch is processed in FIXED windows of kEd25519RlcWindowItems: one
+// RLC check (+ bisect on failure) per window. Window boundaries depend
+// only on item order — never on thread count — so the accept set of the
+// serial path and core/verify_pool.cc's parallel path are identical by
+// construction.
 void ed25519_verify_batch(const uint8_t* pubs, const uint8_t* msgs,
                           const uint8_t* sigs, size_t n, uint8_t* out);
+
+// One RLC window (n <= kEd25519RlcWindowItems enforced by callers; larger
+// n still verifies correctly as a single oversized window). This is the
+// unit of work core/verify_pool.cc hands to its workers; verify_batch is
+// exactly a loop of these. Thread-safe: per-call state only (the comb
+// table is built once under the magic-static lock).
+void ed25519_verify_window(const uint8_t* pubs, const uint8_t* msgs,
+                           const uint8_t* sigs, size_t n, uint8_t* out);
+
+// The fixed RLC window width shared by the serial and pooled paths.
+constexpr size_t kEd25519RlcWindowItems = 256;
+
+// Test hook (ADVICE round-5 medium): simulate entropy exhaustion so the
+// RLC fast path is disabled and windows verify per-item. Never set in
+// production.
+void ed25519_test_force_entropy_exhaustion(bool on);
 
 // Ephemeral DH on edwards25519 for the secure-link handshake
 // (core/secure.cc; mirror of pbft_tpu/net/secure.py dh_keypair/dh_shared).

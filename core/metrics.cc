@@ -25,10 +25,14 @@ const char* kCounterNames[] = {
 const char* kGaugeNames[] = {
     "pbft_verify_queue_depth",
     "pbft_verify_inflight_age_seconds",
+    "pbft_verify_pool_threads",
+    "pbft_verify_pool_queue_depth",
+    "pbft_verify_pool_utilization",
 };
 // name -> uses the size bucket ladder (else latency).
 const std::pair<const char*, bool> kHistogramNames[] = {
     {"pbft_verify_batch_size", true},
+    {"pbft_verify_pool_window_size", true},
     {"pbft_verify_seconds", false},
     {"pbft_phase_pre_prepare_seconds", false},
     {"pbft_phase_prepare_seconds", false},

@@ -15,6 +15,8 @@
 #include <cstring>
 #include <type_traits>
 
+#include "verify_pool.h"
+
 namespace pbft {
 
 namespace {
@@ -703,8 +705,12 @@ void ReplicaServer::run_verify_batch() {
     // Bounded accumulation: hold the queue until the item target or the
     // deadline so one verifier launch carries a whole window instead of
     // one event-loop pass's trickle (network.json verify_flush_us/_items).
+    // The target is sized to the backend's parallel capacity: a
+    // pool-backed CpuVerifier with N lanes wants N windows per dispatch,
+    // not the one-inflight-window shape the async remote path uses.
     int64_t target =
         cfg_.verify_flush_items > 0 ? cfg_.verify_flush_items : cfg_.batch_pad;
+    target *= (int64_t)std::max<size_t>(1, verifier_->parallel_capacity());
     auto now = std::chrono::steady_clock::now();
     if (!verify_window_open_) {
       verify_window_open_ = true;
@@ -749,6 +755,19 @@ void ReplicaServer::deliver_verified(size_t n_items,
     metrics_.observe("pbft_verify_batch_size", (double)n_items);
     metrics_.observe("pbft_verify_seconds", secs);
     metrics_.set_gauge("pbft_verify_inflight_age_seconds", secs);
+    // Native verify-pool surface: exported whenever the pool has run
+    // (CpuVerifier backend, or the CPU safety net behind a remote one).
+    if (global_verify_pool_created()) {
+      const VerifyPoolStats ps = global_verify_pool().stats();
+      metrics_.set_gauge("pbft_verify_pool_threads", (double)ps.threads);
+      metrics_.set_gauge("pbft_verify_pool_queue_depth",
+                         (double)ps.last_queue_depth);
+      metrics_.set_gauge("pbft_verify_pool_utilization", ps.utilization());
+      if (ps.last_window_items > 0) {
+        metrics_.observe("pbft_verify_pool_window_size",
+                         (double)ps.last_window_items);
+      }
+    }
     if (trace_fp_) trace_batch((int64_t)n_items, rejected, secs);
   }
   emit(replica_->deliver_verdicts(verdicts));

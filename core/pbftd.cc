@@ -6,7 +6,8 @@
 //
 // Usage:
 //   pbftd --config network.json --id 0 --seed <64-hex>
-//         [--verifier cpu|host:port|/unix/path] [--metrics-every 5]
+//         [--verifier cpu|host:port|/unix/path] [--verify-threads N]
+//         [--metrics-every 5]
 //
 // The replica listens on its configured port for both framed peer traffic
 // and raw-JSON client connections (sniffed), verifies signature batches via
@@ -22,6 +23,7 @@
 #include "net.h"
 #include "replica.h"
 #include "verifier.h"
+#include "verify_pool.h"
 
 namespace {
 pbft::ReplicaServer* g_server = nullptr;
@@ -37,6 +39,7 @@ int main(int argc, char** argv) {
   int metrics_port = -1;
   int vc_timeout_ms = 0;
   int verify_deadline_ms = -1;
+  int verify_threads = 0;  // 0 = hardware_concurrency (the pool default)
   bool byzantine = false;
   for (int i = 1; i < argc; ++i) {
     std::string a = argv[i];
@@ -49,6 +52,7 @@ int main(int argc, char** argv) {
     else if (a == "--metrics-port") metrics_port = std::atoi(next());
     else if (a == "--vc-timeout-ms") vc_timeout_ms = std::atoi(next());
     else if (a == "--verify-deadline-ms") verify_deadline_ms = std::atoi(next());
+    else if (a == "--verify-threads") verify_threads = std::atoi(next());
     else if (a == "--discovery") discovery = next();
     else if (a == "--trace") trace_path = next();
     else if (a == "--byzantine") byzantine = true;
@@ -60,7 +64,8 @@ int main(int argc, char** argv) {
   if (config_path.empty() || id < 0 || seed_hex.size() != 64) {
     std::fprintf(stderr,
                  "usage: pbftd --config network.json --id N --seed <64-hex> "
-                 "[--verifier cpu|host:port|/unix/path] [--metrics-every S]\n");
+                 "[--verifier cpu|host:port|/unix/path] [--verify-threads N] "
+                 "[--metrics-every S]\n");
     return 2;
   }
 
@@ -87,6 +92,10 @@ int main(int argc, char** argv) {
   }
 
   std::string vsel = verifier_override.empty() ? cfg->verifier : verifier_override;
+  // --verify-threads N: width of the in-process verify pool (default =
+  // hardware_concurrency). Applies to the CpuVerifier backend and to the
+  // CPU safety net behind a remote one; must be set before first use.
+  pbft::set_global_verify_threads(verify_threads);
   std::unique_ptr<pbft::Verifier> verifier;
   if (vsel == "cpu") {
     verifier = std::make_unique<pbft::CpuVerifier>();
@@ -112,8 +121,12 @@ int main(int argc, char** argv) {
   g_server = &server;
   std::signal(SIGINT, on_signal);
   std::signal(SIGTERM, on_signal);
-  std::fprintf(stderr, "pbftd replica %lld listening on %d (verifier=%s)\n",
-               (long long)id, server.listen_port(), vsel.c_str());
+  std::fprintf(stderr,
+               "pbftd replica %lld listening on %d (verifier=%s, "
+               "verify-threads=%d)\n",
+               (long long)id, server.listen_port(), vsel.c_str(),
+               vsel == "cpu" ? pbft::global_verify_pool().threads()
+                             : verify_threads);
   if (server.metrics_listen_port() > 0) {
     std::fprintf(stderr, "pbftd replica %lld metrics on 127.0.0.1:%d\n",
                  (long long)id, server.metrics_listen_port());

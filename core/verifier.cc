@@ -11,14 +11,16 @@
 
 #include "ed25519.h"
 #include "net.h"
+#include "verify_pool.h"
 
 namespace pbft {
 
 std::vector<uint8_t> CpuVerifier::verify_batch(
     const std::vector<VerifyItem>& items) {
-  // Pack into the batch layout and use the RLC + Pippenger batch verify
-  // (core/ed25519.cc): one multi-scalar multiplication per honest window
-  // instead of one Shamir ladder per signature.
+  // Pack into the batch layout and hand the batch to the process-wide
+  // worker pool (core/verify_pool.cc): one RLC + Pippenger window per
+  // worker lane instead of one Shamir ladder per signature, with the
+  // serial path's exact accept set.
   const size_t n = items.size();
   std::vector<uint8_t> pubs(32 * n), msgs(32 * n), sigs(64 * n), out(n);
   for (size_t i = 0; i < n; ++i) {
@@ -26,8 +28,13 @@ std::vector<uint8_t> CpuVerifier::verify_batch(
     std::memcpy(msgs.data() + 32 * i, items[i].msg, 32);
     std::memcpy(sigs.data() + 64 * i, items[i].sig, 64);
   }
-  ed25519_verify_batch(pubs.data(), msgs.data(), sigs.data(), n, out.data());
+  global_verify_pool().verify(pubs.data(), msgs.data(), sigs.data(), n,
+                              out.data());
   return out;
+}
+
+size_t CpuVerifier::parallel_capacity() const {
+  return (size_t)global_verify_pool().threads();
 }
 
 RemoteVerifier::RemoteVerifier(std::string target) : target_(std::move(target)) {}

@@ -1,12 +1,15 @@
 // Pluggable signature-verifier backends (BASELINE.json north_star):
 // `Verifier::verify_batch(items) -> bitmap`.
 //
-// - CpuVerifier: in-process Ed25519 batch verification (core/ed25519.cc
-//   ed25519_verify_batch: random-linear-combination check + Pippenger MSM,
-//   bisecting failing windows to per-item verify) — the control arm
-//   (BASELINE.md configs 1-2). See the accept-set note in ed25519.cc for
-//   the one documented divergence from strict per-item semantics
-//   (colluding torsion-defect pairs inside one window).
+// - CpuVerifier: in-process Ed25519 batch verification through the
+//   process-wide worker pool (core/verify_pool.cc): fixed RLC windows
+//   (random-linear-combination check + Pippenger MSM, bisecting failing
+//   windows to per-item verify) dispatched across threads — the control
+//   arm (BASELINE.md configs 1-2). Pooled and serial verification share
+//   window boundaries, so the accept set is thread-count independent; see
+//   the accept-set note in ed25519.cc for the one documented divergence
+//   from strict per-item semantics (colluding torsion-defect pairs inside
+//   one window).
 // - RemoteVerifier: ships (pubkey, digest, sig) batches over a local socket
 //   to the colocated JAX/TPU service (pbft_tpu/net/service.py), which runs
 //   one vmap'd XLA launch per batch and returns the validity bitmap.
@@ -58,12 +61,18 @@ class Verifier {
   // net.cc check_verify_deadline): drop the transport so a late reply
   // lands on a closed socket instead of mis-pairing with the next batch.
   virtual void cancel_inflight() {}
+  // How many verification lanes one dispatch can occupy — the event loop
+  // sizes its accumulation window to capacity instead of one inflight
+  // window (net.cc run_verify_batch). 1 for serial/remote backends; the
+  // pool-backed CpuVerifier reports its thread count.
+  virtual size_t parallel_capacity() const { return 1; }
 };
 
 class CpuVerifier : public Verifier {
  public:
   std::vector<uint8_t> verify_batch(
       const std::vector<VerifyItem>& items) override;
+  size_t parallel_capacity() const override;
 };
 
 class RemoteVerifier : public Verifier {

@@ -1,0 +1,175 @@
+#include "verify_pool.h"
+
+#include <chrono>
+#include <condition_variable>
+#include <memory>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+#include "ed25519.h"
+
+namespace pbft {
+
+namespace {
+double now_s() {
+  return std::chrono::duration<double>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+}  // namespace
+
+struct VerifyPool::Impl {
+  // The batch being verified (one at a time; verify() holds batch_mu_).
+  // Windows are [w * kEd25519RlcWindowItems, ...) slices of these arrays;
+  // workers write disjoint out ranges, so only the cursor/remaining
+  // bookkeeping needs the lock.
+  const uint8_t* pubs = nullptr;
+  const uint8_t* msgs = nullptr;
+  const uint8_t* sigs = nullptr;
+  uint8_t* out = nullptr;
+  size_t n = 0;
+  size_t next_window = 0;   // next window index to claim
+  size_t total_windows = 0;
+  size_t done_windows = 0;
+  uint64_t generation = 0;  // bumps per batch: wakes workers exactly once
+  bool shutdown = false;
+  double batch_busy = 0;    // per-window execution time, this batch
+
+  std::mutex mu;
+  std::condition_variable work_cv;  // workers: new batch or shutdown
+  std::condition_variable done_cv;  // caller: all windows finished
+
+  std::mutex batch_mu;  // serializes verify() callers
+  std::vector<std::thread> workers;
+
+  mutable std::mutex stats_mu;
+  VerifyPoolStats stats;
+
+  // Claim and run windows until the current batch is drained. Returns
+  // with mu held by nobody; updates done bookkeeping under mu.
+  void drain(std::unique_lock<std::mutex>& lk) {
+    while (next_window < total_windows) {
+      const size_t w = next_window++;
+      lk.unlock();
+      const size_t off = w * kEd25519RlcWindowItems;
+      const size_t count = n - off < kEd25519RlcWindowItems
+                               ? n - off
+                               : kEd25519RlcWindowItems;
+      const double t0 = now_s();
+      ed25519_verify_window(pubs + 32 * off, msgs + 32 * off, sigs + 64 * off,
+                            count, out + off);
+      const double busy = now_s() - t0;
+      lk.lock();
+      batch_busy += busy;
+      if (++done_windows == total_windows) done_cv.notify_all();
+    }
+  }
+
+  void worker_loop() {
+    std::unique_lock<std::mutex> lk(mu);
+    uint64_t seen = 0;
+    for (;;) {
+      work_cv.wait(lk, [&] { return shutdown || generation != seen; });
+      if (shutdown) return;
+      seen = generation;
+      drain(lk);
+    }
+  }
+};
+
+VerifyPool::VerifyPool(int threads) : impl_(new Impl) {
+  if (threads <= 0) {
+    threads = (int)std::thread::hardware_concurrency();
+    if (threads <= 0) threads = 1;
+  }
+  threads_ = threads;
+  impl_->stats.threads = threads;
+  // threads-1 workers: the verify() caller is the last lane.
+  for (int i = 1; i < threads; ++i) {
+    impl_->workers.emplace_back([impl = impl_] { impl->worker_loop(); });
+  }
+}
+
+VerifyPool::~VerifyPool() {
+  {
+    std::lock_guard<std::mutex> lk(impl_->mu);
+    impl_->shutdown = true;
+  }
+  impl_->work_cv.notify_all();
+  for (auto& t : impl_->workers) t.join();
+  delete impl_;
+}
+
+void VerifyPool::verify(const uint8_t* pubs, const uint8_t* msgs,
+                        const uint8_t* sigs, size_t n, uint8_t* out) {
+  if (n == 0) return;
+  Impl& im = *impl_;
+  std::lock_guard<std::mutex> batch_lk(im.batch_mu);
+  const double t0 = now_s();
+  const size_t windows =
+      (n + kEd25519RlcWindowItems - 1) / kEd25519RlcWindowItems;
+  {
+    std::unique_lock<std::mutex> lk(im.mu);
+    im.pubs = pubs;
+    im.msgs = msgs;
+    im.sigs = sigs;
+    im.out = out;
+    im.n = n;
+    im.next_window = 0;
+    im.total_windows = windows;
+    im.done_windows = 0;
+    im.batch_busy = 0;
+    ++im.generation;
+    if (windows > 1 && !im.workers.empty()) im.work_cv.notify_all();
+    // The caller drains alongside the workers (threads=1: the whole
+    // batch, serially, with no other thread ever woken).
+    im.drain(lk);
+    im.done_cv.wait(lk, [&] { return im.done_windows == im.total_windows; });
+  }
+  const double wall = now_s() - t0;
+  {
+    std::lock_guard<std::mutex> lk(im.stats_mu);
+    std::lock_guard<std::mutex> lk2(im.mu);  // batch_busy
+    im.stats.batches += 1;
+    im.stats.windows += (int64_t)windows;
+    im.stats.items += (int64_t)n;
+    im.stats.busy_seconds += im.batch_busy;
+    im.stats.wall_seconds += wall;
+    im.stats.last_queue_depth = (int64_t)windows;
+    im.stats.last_window_items =
+        (int64_t)(n < kEd25519RlcWindowItems ? n : kEd25519RlcWindowItems);
+  }
+}
+
+VerifyPoolStats VerifyPool::stats() const {
+  std::lock_guard<std::mutex> lk(impl_->stats_mu);
+  return impl_->stats;
+}
+
+// --- process-wide pool ------------------------------------------------------
+
+namespace {
+std::mutex g_pool_mu;
+std::unique_ptr<VerifyPool> g_pool;
+int g_pool_threads = 0;  // 0 = hardware concurrency
+}  // namespace
+
+VerifyPool& global_verify_pool() {
+  std::lock_guard<std::mutex> lk(g_pool_mu);
+  if (!g_pool) g_pool = std::make_unique<VerifyPool>(g_pool_threads);
+  return *g_pool;
+}
+
+void set_global_verify_threads(int threads) {
+  std::lock_guard<std::mutex> lk(g_pool_mu);
+  g_pool_threads = threads;
+  g_pool.reset();  // recreated at the new width on next use
+}
+
+bool global_verify_pool_created() {
+  std::lock_guard<std::mutex> lk(g_pool_mu);
+  return g_pool != nullptr;
+}
+
+}  // namespace pbft

@@ -1,0 +1,78 @@
+// Parallel batch-verification engine (ISSUE 2 tentpole): a persistent
+// worker pool that splits each verify batch into the same fixed RLC
+// windows the serial path uses (core/ed25519.cc kEd25519RlcWindowItems)
+// and runs them across threads. Window boundaries depend only on item
+// order — never on thread count — so pooled and serial verification have
+// identical accept sets by construction (pinned by tests/test_verify_pool.py
+// and core_test.cc); each window keeps the full serial semantics (pipelined
+// hash/decompress prep, RLC check, bisect-to-per-item fallback).
+//
+// The calling thread participates: a pool of N threads is (N-1) workers
+// plus the caller draining the same window queue, so threads=1 is the
+// exact serial path with zero synchronization or handoff cost, and a
+// verify() call never blocks on a context switch for the last window.
+#pragma once
+
+#include <cstddef>
+#include <cstdint>
+
+namespace pbft {
+
+// Counters a pool accumulates over its lifetime, exported as gauges /
+// histograms by core/net.cc (manifest: pbft_tpu/utils/trace_schema.py)
+// and as JSON via the C ABI (capi.cc pbft_verify_pool_stats_json).
+struct VerifyPoolStats {
+  int threads = 1;              // pool width (workers + calling thread)
+  int64_t batches = 0;          // verify() calls
+  int64_t windows = 0;          // RLC windows executed
+  int64_t items = 0;            // signatures verified
+  double busy_seconds = 0;      // sum of per-window execution time
+  double wall_seconds = 0;      // sum of verify() wall times
+  int64_t last_queue_depth = 0; // windows queued by the last batch
+  int64_t last_window_items = 0;// widest window of the last batch
+  // busy / (wall * threads): 1.0 = every thread busy for the whole batch.
+  double utilization() const {
+    double denom = wall_seconds * threads;
+    return denom > 0 ? busy_seconds / denom : 0.0;
+  }
+};
+
+class VerifyPool {
+ public:
+  // threads == 0 selects std::thread::hardware_concurrency() (min 1).
+  explicit VerifyPool(int threads = 0);
+  ~VerifyPool();
+  VerifyPool(const VerifyPool&) = delete;
+  VerifyPool& operator=(const VerifyPool&) = delete;
+
+  int threads() const { return threads_; }
+
+  // Verify n packed items (pubs n*32, msgs n*32, sigs n*64) into out
+  // (n bytes 0/1). Blocks until every window completes. Serialized:
+  // concurrent callers queue on an internal mutex (the replica event
+  // loop is single-threaded; the lock exists for the Python binding).
+  void verify(const uint8_t* pubs, const uint8_t* msgs, const uint8_t* sigs,
+              size_t n, uint8_t* out);
+
+  VerifyPoolStats stats() const;
+
+ private:
+  struct Impl;
+  Impl* impl_;
+  int threads_;
+};
+
+// The process-wide pool backing CpuVerifier and the C ABI batch entry
+// point. Created lazily at the configured width (default: hardware
+// concurrency); set_global_verify_threads reconfigures it, tearing down
+// any existing pool (safe whenever no verify call is in flight — pbftd
+// applies it before the event loop starts, the Python binding between
+// batches).
+VerifyPool& global_verify_pool();
+void set_global_verify_threads(int threads);
+// True once the process-wide pool exists — metrics exporters check this
+// so a replica on a remote-verifier backend never spawns worker threads
+// just to report zeros.
+bool global_verify_pool_created();
+
+}  // namespace pbft

@@ -9,7 +9,9 @@ environment; the C ABI in core/capi.cc is the binding surface.
 from __future__ import annotations
 
 import ctypes
+import json
 import os
+import shutil
 import subprocess
 from pathlib import Path
 from typing import List, Optional, Sequence, Tuple
@@ -20,11 +22,48 @@ _LIB_PATH = _BUILD_DIR / "libpbftcore.so"
 
 _lib: Optional[ctypes.CDLL] = None
 
+# Library sources in core/CMakeLists.txt order; pbftd.cc / core_test.cc
+# link against the shared library.
+_LIB_SOURCES = [
+    "blake2b.cc", "sha512.cc", "ed25519.cc", "json.cc", "messages.cc",
+    "metrics.cc", "replica.cc", "verifier.cc", "verify_pool.cc",
+    "secure.cc", "net.cc", "discovery.cc", "capi.cc",
+]
+
+
+def _build_direct() -> Path:
+    """Fallback build without cmake/ninja: drive g++ directly (same flags
+    as the CMake Release config). Keeps the native arm usable on stripped
+    containers where only a compiler is present."""
+    cxx = shutil.which("g++") or shutil.which("c++") or shutil.which("clang++")
+    if cxx is None:
+        raise RuntimeError("no C++ compiler found for the native core")
+    _BUILD_DIR.mkdir(exist_ok=True)
+    core = _REPO_ROOT / "core"
+    common = ["-O2", "-std=c++17", "-Wall", "-Wextra", "-pthread"]
+    subprocess.run(
+        [cxx, *common, "-fPIC", "-shared", "-o", str(_LIB_PATH)]
+        + [str(core / s) for s in _LIB_SOURCES],
+        check=True,
+        capture_output=True,
+    )
+    for exe, src in (("pbftd", "pbftd.cc"), ("core_test", "core_test.cc")):
+        subprocess.run(
+            [cxx, *common, "-o", str(_BUILD_DIR / exe), str(core / src),
+             "-L", str(_BUILD_DIR), "-lpbftcore", "-Wl,-rpath,$ORIGIN"],
+            check=True,
+            capture_output=True,
+        )
+    return _LIB_PATH
+
 
 def build(force: bool = False) -> Path:
-    """Build the native core with cmake+ninja (idempotent)."""
+    """Build the native core with cmake+ninja (idempotent); falls back to
+    a direct g++ build when cmake or ninja is unavailable."""
     if _LIB_PATH.exists() and not force:
         return _LIB_PATH
+    if shutil.which("cmake") is None or shutil.which("ninja") is None:
+        return _build_direct()
     subprocess.run(
         ["cmake", "-S", str(_REPO_ROOT / "core"), "-B", str(_BUILD_DIR), "-G", "Ninja"],
         check=True,
@@ -117,7 +156,9 @@ def aead_open(key: bytes, ctr: int, sealed: bytes) -> Optional[bytes]:
 
 def verify_batch(items: Sequence[Tuple[bytes, bytes, bytes]]) -> List[bool]:
     """Native batch verify over (pub32, msg32, sig64) triples — the CPU
-    control arm with the same call shape as crypto.batch.verify_many."""
+    control arm with the same call shape as crypto.batch.verify_many.
+    Dispatched through the native verify pool (core/verify_pool.cc); width
+    is set_verify_threads (default: hardware concurrency)."""
     n = len(items)
     if n == 0:
         return []
@@ -127,3 +168,32 @@ def verify_batch(items: Sequence[Tuple[bytes, bytes, bytes]]) -> List[bool]:
     out = ctypes.create_string_buffer(n)
     lib().pbft_ed25519_verify_batch(pubs, msgs, sigs, out, n)
     return [b == 1 for b in out.raw]
+
+
+def set_verify_threads(threads: int) -> None:
+    """Reconfigure the native verify pool width (0 = hardware
+    concurrency). Tears down the existing pool; call between batches."""
+    lib().pbft_set_verify_threads(ctypes.c_int(threads))
+
+
+def verify_threads() -> int:
+    """The native verify pool's actual width (creates the pool)."""
+    fn = lib().pbft_verify_threads
+    fn.restype = ctypes.c_int
+    return fn()
+
+
+def verify_pool_stats() -> dict:
+    """Lifetime pool counters: threads, batches, windows, items, busy/wall
+    seconds, utilization, last queue depth / window items."""
+    fn = lib().pbft_verify_pool_stats_json
+    fn.restype = ctypes.c_size_t
+    buf = ctypes.create_string_buffer(512)
+    n = fn(buf, len(buf))
+    return json.loads(buf.raw[:n].decode())
+
+
+def force_entropy_exhaustion(on: bool) -> None:
+    """TEST hook: simulate entropy exhaustion so the RLC fast path
+    disables and windows verify per-item (ADVICE round-5 regression)."""
+    lib().pbft_test_force_entropy_exhaustion(ctypes.c_int(1 if on else 0))

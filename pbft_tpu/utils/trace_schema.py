@@ -77,6 +77,14 @@ METRIC_SCHEMAS = {
     "pbft_verify_deadline_fired_total": ("counter", {"net.cc"}),
     "pbft_verify_queue_depth": ("gauge", {"server.py", "service.py", "net.cc"}),
     "pbft_verify_inflight_age_seconds": ("gauge", {"server.py", "service.py", "net.cc"}),
+    # Native verify-pool surface (core/verify_pool.cc): pool width, windows
+    # queued by the last dispatch, lifetime busy/(wall*threads) ratio, and
+    # the per-dispatch RLC window width. C++ runtime only — the Python
+    # replica's parallelism lives in the JAX mesh, not a thread pool.
+    "pbft_verify_pool_threads": ("gauge", {"net.cc"}),
+    "pbft_verify_pool_queue_depth": ("gauge", {"net.cc"}),
+    "pbft_verify_pool_utilization": ("gauge", {"net.cc"}),
+    "pbft_verify_pool_window_size": ("histogram", {"net.cc"}),
     "pbft_verify_batch_size": ("histogram", {"server.py", "service.py", "net.cc"}),
     "pbft_verify_seconds": ("histogram", {"server.py", "service.py", "net.cc"}),
     "pbft_phase_pre_prepare_seconds": ("histogram", {"server.py", "net.cc"}),
@@ -112,4 +120,6 @@ def histogram_buckets(name: str):
     """The fixed bucket edges for a manifest histogram."""
     if METRIC_SCHEMAS[name][0] != "histogram":
         raise ValueError(f"{name} is not a histogram")
-    return BATCH_SIZE_BUCKETS if name == "pbft_verify_batch_size" else LATENCY_BUCKETS_S
+    if name in ("pbft_verify_batch_size", "pbft_verify_pool_window_size"):
+        return BATCH_SIZE_BUCKETS
+    return LATENCY_BUCKETS_S

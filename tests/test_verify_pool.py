@@ -1,0 +1,244 @@
+"""Pool/serial verdict parity for the native verify pool (ISSUE 2).
+
+The C++ batch path splits every batch into fixed RLC windows
+(core/ed25519.cc kEd25519RlcWindowItems = 256) whose boundaries depend
+only on item order — never on thread count — so the accept set must be
+identical across pool widths, including the documented torsion-pair
+caveat. Also the ADVICE round-5 regression: entropy exhaustion must
+disable the RLC fast path (per-item verification), not fall back to
+predictable coefficients a crafted cancelling pair could satisfy.
+"""
+
+import os
+import random
+
+import pytest
+
+from pbft_tpu import native
+from pbft_tpu.crypto import ref
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native core not buildable"
+)
+
+WINDOW = 256  # mirrors core/ed25519.h kEd25519RlcWindowItems
+
+THREAD_COUNTS = sorted({1, 2, os.cpu_count() or 1})
+
+
+@pytest.fixture(autouse=True)
+def _restore_pool():
+    yield
+    native.force_entropy_exhaustion(False)
+    native.set_verify_threads(0)
+
+
+# Torsion-defect crafting (same construction as tests/test_native_crypto.py,
+# duplicated here because that module's hypothesis importorskip would skip
+# this whole file on import).
+
+
+def _torsion_point():
+    """A nonzero small-order point: [L]P for a curve point P outside the
+    prime subgroup."""
+    for y in range(2, 60):
+        pt = ref.point_decompress(y.to_bytes(32, "little"))
+        if pt is None:
+            continue
+        t = ref.scalar_mult(ref.L, pt)
+        if t != (0, 1):
+            return t
+    raise AssertionError("no torsion point found in scan range")
+
+
+def _craft_torsion_sig(seed: bytes, msg: bytes, defect):
+    """A signature whose verification defect is exactly -defect (a
+    Byzantine signer using its own secret key)."""
+    a, _prefix = ref.secret_expand(seed)
+    pub = ref.point_compress(ref.scalar_mult(a, ref.BASE))
+    r = 0x1234567
+    big_r = ref.point_compress(
+        ref.point_add(ref.scalar_mult(r, ref.BASE), defect)
+    )
+    h = ref._h512_int(big_r, pub, msg) % ref.L
+    s = (r + h * a) % ref.L
+    return pub, big_r + s.to_bytes(32, "little")
+
+
+def _signed(i: int, msg: bytes | None = None):
+    seed = bytes([i % 249 + 1, 0x5C]) * 16
+    m = msg if msg is not None else bytes([i % 256, 0x77]) * 16
+    return (native.public_key(seed), m, native.sign(seed, m))
+
+
+def _corrupt(item, off: int = 40):
+    pub, msg, sig = item
+    return (pub, msg, sig[:off] + bytes([sig[off] ^ 0x5A]) + sig[off + 1 :])
+
+
+def test_pool_parity_invalids_at_window_boundaries():
+    """Invalid signatures pinned to every window edge (first/last item of
+    each 256-wide window) plus random interior corruption: identical
+    accept sets at thread counts {1, 2, hardware}, all equal to per-item
+    verify."""
+    n = 2 * WINDOW + 37  # three windows, last one ragged
+    items = [_signed(i) for i in range(n)]
+    rng = random.Random(0x5EED)
+    bad = {0, WINDOW - 1, WINDOW, 2 * WINDOW - 1, 2 * WINDOW, n - 1}
+    bad |= {rng.randrange(n) for _ in range(5)}
+    for i in bad:
+        items[i] = _corrupt(items[i])
+    want = [i not in bad for i in range(n)]
+    verdicts = {}
+    for t in THREAD_COUNTS:
+        native.set_verify_threads(t)
+        assert native.verify_threads() == t
+        verdicts[t] = native.verify_batch(items)
+        assert verdicts[t] == want, f"threads={t}"
+    assert len({tuple(v) for v in verdicts.values()}) == 1
+
+
+def test_pool_parity_randomized_batches():
+    """Randomized sizes (straddling the window width and the RLC
+    crossover) and corruption patterns: every thread count agrees with
+    per-item verify."""
+    rng = random.Random(7)
+    for trial, n in enumerate([1, 7, 8, 255, 256, 257, 300]):
+        items = [_signed(1000 * trial + i) for i in range(n)]
+        bad = {rng.randrange(n) for _ in range(rng.randrange(0, 4))}
+        for i in bad:
+            items[i] = _corrupt(items[i], off=rng.randrange(64))
+        per_item = [native.verify(p, m, s) for p, m, s in items]
+        for t in THREAD_COUNTS:
+            native.set_verify_threads(t)
+            assert native.verify_batch(items) == per_item, (n, t)
+
+
+def test_torsion_pair_same_window_consistent_across_thread_counts():
+    """The documented accept-set caveat is thread-count independent: a
+    cancelling torsion-defect pair INSIDE one window is batch-accepted
+    identically at every pool width (window composition is fixed by item
+    order, so replicas with different --verify-threads cannot disagree)."""
+
+    t = _torsion_point()
+    neg_t = (ref.P - t[0], t[1])
+    crafted = []
+    for i, defect in ((0, t), (1, neg_t)):
+        seed = bytes([i + 1]) * 32
+        msg = bytes([0xE0 + i]) * 32
+        pub, bad = _craft_torsion_sig(seed, msg, defect)
+        assert not native.verify(pub, msg, bad)
+        crafted.append((pub, msg, bad))
+    items = [_signed(i) for i in range(10)] + crafted  # one window
+    for threads in THREAD_COUNTS:
+        native.set_verify_threads(threads)
+        assert native.verify_batch(items) == [True] * 12, threads
+
+
+def test_torsion_pair_split_across_windows_rejected_at_every_width():
+    """The same pair split across the fixed window boundary (item indices
+    WINDOW-1 and WINDOW): each window's RLC sees a lone defect, the
+    bisect runs, and per-item authority rejects both — at every thread
+    count, i.e. also when the two windows run on different workers."""
+
+    t = _torsion_point()
+    neg_t = (ref.P - t[0], t[1])
+    pair = []
+    for i, defect in ((0, t), (1, neg_t)):
+        msg = bytes([0xE0 + i]) * 32
+        pub, bad = _craft_torsion_sig(bytes([i + 1]) * 32, msg, defect)
+        pair.append((pub, msg, bad))
+    n = WINDOW + 8
+    items = [_signed(i) for i in range(n)]
+    items[WINDOW - 1] = pair[0]
+    items[WINDOW] = pair[1]
+    want = [True] * n
+    want[WINDOW - 1] = want[WINDOW] = False
+    for threads in THREAD_COUNTS:
+        native.set_verify_threads(threads)
+        assert native.verify_batch(items) == want, threads
+
+
+def test_entropy_exhaustion_disables_rlc_and_rejects_cancelling_pair():
+    """ADVICE round-5 medium regression: with entropy exhausted the RLC
+    fast path must be disabled entirely — windows verify per-item, so the
+    crafted cancelling-defect pair that the (randomized) RLC accepts is
+    now rejected, and honest items still pass. The old behavior derived
+    coefficients from a predictable counter, which a forger could satisfy."""
+
+    t = _torsion_point()
+    neg_t = (ref.P - t[0], t[1])
+    crafted = []
+    for i, defect in ((0, t), (1, neg_t)):
+        pub, bad = _craft_torsion_sig(
+            bytes([i + 1]) * 32, bytes([0xE0 + i]) * 32, defect
+        )
+        crafted.append((pub, bytes([0xE0 + i]) * 32, bad))
+    items = [_signed(i) for i in range(10)] + crafted
+    # Sanity: with entropy, the pair is the documented in-window accept.
+    native.set_verify_threads(1)
+    assert native.verify_batch(items) == [True] * 12
+    native.force_entropy_exhaustion(True)
+    try:
+        for threads in THREAD_COUNTS:
+            native.set_verify_threads(threads)
+            verdicts = native.verify_batch(items)
+            assert verdicts == [True] * 10 + [False, False], threads
+    finally:
+        native.force_entropy_exhaustion(False)
+    # Entropy restored: the fast path (and its documented caveat) return.
+    assert native.verify_batch(items) == [True] * 12
+
+
+def test_pool_lifecycle_stress_fast():
+    """Tier-1 pool stress, no sleeps: repeated reconfigure + verify +
+    implicit teardown across widths, interleaving batch sizes above and
+    below the window width; verdicts stay exact throughout and the stats
+    counters add up."""
+    base = [_signed(i) for i in range(70)]
+    bad_idx = 33
+    batch = list(base)
+    batch[bad_idx] = _corrupt(batch[bad_idx])
+    want = [i != bad_idx for i in range(len(batch))]
+    for threads in (1, 2, 3, 1, 2):
+        native.set_verify_threads(threads)
+        for size in (1, 8, 70):
+            sub = batch[:size]
+            assert native.verify_batch(sub) == want[:size], (threads, size)
+    stats = native.verify_pool_stats()
+    assert stats["threads"] == 2  # last configured width
+    assert stats["batches"] == 3 and stats["windows"] == 3
+    assert stats["items"] == 79
+    assert stats["wall_seconds"] > 0
+    assert 0.0 <= stats["utilization"] <= 1.0 + 1e-9
+
+
+def test_bench_native_arm_reports_threads(tmp_path):
+    """The bench's native arm must emit threads + single-thread vs pooled
+    rates (acceptance criterion surface) — run it in-process-shaped via a
+    subprocess with a tiny budget."""
+    import json
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env.update(
+        PBFT_BENCH_NATIVE="1",
+        PBFT_BENCH_SECS="0.2",
+        PBFT_BENCH_BATCH="64",
+        PBFT_VERIFY_THREADS="2",
+    )
+    out = subprocess.run(
+        [sys.executable, str(native._REPO_ROOT / "bench.py")],
+        capture_output=True,
+        text=True,
+        env=env,
+        timeout=300,
+    )
+    assert out.returncode == 0, out.stderr
+    result = json.loads(out.stdout.strip().splitlines()[-1])
+    assert result["backend"] == "cpu-native"
+    assert result["threads"] == 2
+    assert result["single_thread_per_sec"] > 0
+    assert result["pooled_per_sec"] == result["value"]
+    assert result["pool_speedup"] > 0
