@@ -192,6 +192,33 @@ def _probe_tpu(
     return False
 
 
+def _tpu_indicators() -> list:
+    """Environment signals that a TPU could plausibly be reachable.
+
+    The probe loop exists for a tunnel that might come back; when the
+    environment already rules a chip out (no accelerator device nodes, no
+    tunnel/proxy configuration), 8 x 60 s of probing just delays the
+    inevitable CPU fallback (the BENCH_r05 lesson: 480 s spent learning
+    what the environment already said). A bare libtpu *module* is not an
+    indicator — the image bakes it in everywhere; without device nodes it
+    cannot drive anything.
+    """
+    import glob
+
+    found = []
+    plat = os.environ.get("JAX_PLATFORMS", "")
+    if "tpu" in plat or "proxy" in plat:
+        found.append(f"JAX_PLATFORMS={plat}")
+    for var in sorted(os.environ):
+        if var.startswith(("TPU_", "PJRT_")):
+            found.append(var)
+    for dev in glob.glob("/dev/accel*"):
+        found.append(dev)
+    if os.path.exists("/dev/vfio"):
+        found.append("/dev/vfio")
+    return found
+
+
 def _init_backend(timeout_s: float):
     """Initialize the backend under a watchdog.
 
@@ -409,14 +436,27 @@ def main() -> None:
     # TPU path: probe in disposable subprocesses, then run the bench in a
     # killable worker; retry (with a short re-probe) if the worker wedges.
     # PBFT_TPU_PROBE_BUDGET_S caps the whole probe loop (BENCH_r05 burned
-    # 8 x 60 s before the inevitable fallback).
-    probe_budget = float(os.environ.get("PBFT_TPU_PROBE_BUDGET_S", "240"))
-    probed = _probe_tpu(
-        timeout_s=float(os.environ.get("PBFT_BENCH_PROBE_TIMEOUT", "60")),
-        attempts=int(os.environ.get("PBFT_BENCH_PROBES", "8")),
-        gap_s=float(os.environ.get("PBFT_BENCH_PROBE_GAP", "10")),
-        budget_s=probe_budget,
-    )
+    # 8 x 60 s before the inevitable fallback) — and, when set explicitly,
+    # forces probing even where the environment shows no chip indicators.
+    probe_budget_env = os.environ.get("PBFT_TPU_PROBE_BUDGET_S")
+    probe_budget = float(probe_budget_env or "240")
+    indicators = _tpu_indicators()
+    if not indicators and probe_budget_env is None:
+        _log(
+            "tpu probe: skipped entirely — no accelerator device nodes or "
+            "tunnel indicators in the environment (set "
+            "PBFT_TPU_PROBE_BUDGET_S to force probing)"
+        )
+        probed = False
+    else:
+        if indicators:
+            _log(f"tpu indicators: {', '.join(indicators)}")
+        probed = _probe_tpu(
+            timeout_s=float(os.environ.get("PBFT_BENCH_PROBE_TIMEOUT", "60")),
+            attempts=int(os.environ.get("PBFT_BENCH_PROBES", "8")),
+            gap_s=float(os.environ.get("PBFT_BENCH_PROBE_GAP", "10")),
+            budget_s=probe_budget,
+        )
     if probed:
         worker_timeout = float(os.environ.get("PBFT_BENCH_WORKER_TIMEOUT", "600"))
         tpu_attempts = int(os.environ.get("PBFT_BENCH_TPU_ATTEMPTS", "3"))

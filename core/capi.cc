@@ -123,6 +123,61 @@ void pbft_test_force_entropy_exhaustion(int on) {
   pbft::ed25519_test_force_entropy_exhaustion(on != 0);
 }
 
+// Per-key decompressed-point cache controls (window-prep memoization):
+// clear drops entries; disable forces the cold path. The Python parity
+// test pins warm/cold verdict equality through these.
+void pbft_pubkey_cache_clear(void) { pbft::ed25519_pubkey_cache_clear(); }
+
+void pbft_test_pubkey_cache_disable(int on) {
+  pbft::ed25519_test_pubkey_cache_disable(on != 0);
+}
+
+// --- Binary-v2 wire codec surface (tests/test_wire_codec.py).
+//
+// Encode a message given as a JSON payload into the binary-v2 layout
+// (returns the binary length, 0 when the type has no binary form or the
+// payload doesn't parse; out must hold cap bytes). The Python side
+// compares these bytes against its own to_binary output — the
+// cross-runtime byte-parity fuzz.
+size_t pbft_message_to_binary(const uint8_t* payload, size_t payload_len,
+                              uint8_t* out, size_t cap) {
+  std::string text((const char*)payload, payload_len);
+  auto msg = pbft::from_payload(text);
+  if (!msg) return 0;
+  std::string bin;
+  if (!pbft::message_to_binary(*msg, &bin)) return 0;
+  if (bin.size() <= cap) std::memcpy(out, bin.data(), bin.size());
+  return bin.size();
+}
+
+// Decode a binary-v2 payload and re-serialize canonically; also emits the
+// signable digest derived from the payload (the receive-side reuse path).
+// Returns the canonical length (0 on decode failure).
+size_t pbft_message_from_binary(const uint8_t* payload, size_t payload_len,
+                                uint8_t* out_canonical, size_t cap,
+                                uint8_t out_digest[32]) {
+  std::string text((const char*)payload, payload_len);
+  auto msg = pbft::message_from_binary(text);
+  if (!msg) return 0;
+  std::string canon = pbft::message_canonical(*msg);
+  if (canon.size() <= cap) std::memcpy(out_canonical, canon.data(), canon.size());
+  pbft::message_signable_from_payload(text, *msg, out_digest);
+  return canon.size();
+}
+
+// Signable digest derived from a framed payload (JSON sig-splice or
+// binary template) — the Python parity test compares this against the
+// parse -> re-serialize derivation for every message type. Returns 1 on
+// parse success.
+int pbft_signable_from_payload(const uint8_t* payload, size_t payload_len,
+                               uint8_t out_digest[32]) {
+  std::string text((const char*)payload, payload_len);
+  auto msg = pbft::from_payload(text);
+  if (!msg) return 0;
+  pbft::message_signable_from_payload(text, *msg, out_digest);
+  return 1;
+}
+
 // --- Observability schema-parity surface (core/metrics.cc tables).
 //
 // The mixed-runtime contract (pbft_tpu/utils/trace_schema.py) requires

@@ -6,6 +6,9 @@
 #include <atomic>
 #include <cstdio>
 #include <cstring>
+#include <map>
+#include <mutex>
+#include <shared_mutex>
 #include <vector>
 
 #include "sha512.h"
@@ -751,6 +754,51 @@ ge msm_pippenger(const std::vector<ge>& pts,
   return acc;
 }
 
+// Per-key decompressed-point cache for window prep: a replica verifies
+// against a tiny, stable key set (n replica identities + a handful of
+// clients), so the pubkey decompression — a field inverse-sqrt
+// exponentiation per item — is almost always redundant. Keyed by the 32
+// raw pubkey bytes; negative results (non-canonical / off-curve keys)
+// are cached too, and ge_decompress is deterministic, so this is pure
+// memoization — the accept set cannot move (parity pinned by
+// tests/test_verify_pool.py against the cold path). Shared by every
+// pool worker: hits take a shared lock, first-sight inserts the
+// exclusive lock; at the (generous) bound the map is cleared outright —
+// the working set is orders of magnitude smaller.
+struct PubkeyCacheEntry {
+  ge pt;
+  bool valid;
+};
+std::shared_mutex g_pubkey_cache_mu;
+std::map<std::array<uint8_t, 32>, PubkeyCacheEntry> g_pubkey_cache;
+std::atomic<bool> g_pubkey_cache_disabled{false};
+constexpr size_t kPubkeyCacheMax = 1024;
+
+bool cached_decompress_pubkey(ge* out, const uint8_t pub[32]) {
+  if (g_pubkey_cache_disabled.load(std::memory_order_relaxed)) {
+    return ge_decompress(out, pub);
+  }
+  std::array<uint8_t, 32> key;
+  std::memcpy(key.data(), pub, 32);
+  {
+    std::shared_lock<std::shared_mutex> lk(g_pubkey_cache_mu);
+    auto it = g_pubkey_cache.find(key);
+    if (it != g_pubkey_cache.end()) {
+      if (it->second.valid) *out = it->second.pt;
+      return it->second.valid;
+    }
+  }
+  PubkeyCacheEntry e;
+  e.valid = ge_decompress(&e.pt, pub);
+  {
+    std::unique_lock<std::shared_mutex> lk(g_pubkey_cache_mu);
+    if (g_pubkey_cache.size() >= kPubkeyCacheMax) g_pubkey_cache.clear();
+    g_pubkey_cache.emplace(key, e);
+  }
+  if (e.valid) *out = e.pt;
+  return e.valid;
+}
+
 // Per-item state shared by the RLC fast path and the bisect fallback
 // (only items whose decompressions + S<L pre-checks passed are prepared;
 // the `live` index set tracks exactly those).
@@ -849,6 +897,16 @@ void ed25519_test_force_entropy_exhaustion(bool on) {
   g_force_entropy_exhaustion.store(on, std::memory_order_relaxed);
 }
 
+void ed25519_pubkey_cache_clear() {
+  std::unique_lock<std::shared_mutex> lk(g_pubkey_cache_mu);
+  g_pubkey_cache.clear();
+}
+
+void ed25519_test_pubkey_cache_disable(bool on) {
+  g_pubkey_cache_disabled.store(on, std::memory_order_relaxed);
+  if (on) ed25519_pubkey_cache_clear();
+}
+
 void ed25519_verify_window(const uint8_t* pubs, const uint8_t* msgs,
                            const uint8_t* sigs, size_t n, uint8_t* out) {
   if (n < 8) {
@@ -876,7 +934,7 @@ void ed25519_verify_window(const uint8_t* pubs, const uint8_t* msgs,
   }
   for (size_t i = 0; i < n; ++i) {
     BatchPrep& it = prep[i];
-    if (!ge_decompress(&it.a, pubs + 32 * i)) continue;
+    if (!cached_decompress_pubkey(&it.a, pubs + 32 * i)) continue;
     // R must be a canonical curve-point encoding: the per-item check
     // compares encode([S]B - [h]A) against the R bytes, and encode()
     // only emits canonical encodings — ge_decompress accepts exactly

@@ -59,6 +59,13 @@ constexpr size_t kEd25519RlcWindowItems = 256;
 // production.
 void ed25519_test_force_entropy_exhaustion(bool on);
 
+// Per-key decompressed-point cache controls (window-prep memoization of
+// pubkey decompression; see ed25519.cc). Clear drops all entries; the
+// disable hook forces the cold path — tests/test_verify_pool.py pins
+// warm/cold verdict parity through both.
+void ed25519_pubkey_cache_clear();
+void ed25519_test_pubkey_cache_disable(bool on);
+
 // Ephemeral DH on edwards25519 for the secure-link handshake
 // (core/secure.cc; mirror of pbft_tpu/net/secure.py dh_keypair/dh_shared).
 // Public key from a 32-byte secret (clamped X25519-style).

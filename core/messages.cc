@@ -159,11 +159,150 @@ std::string message_canonical(const Message& m) {
   return message_to_json(m).dump();
 }
 
+namespace {
+
+// Fixed canonical-JSON signable templates for the hot message types: the
+// generic path (build a Json object, sort, dump) costs a tree of
+// allocations per message; these emit the identical bytes directly.
+// Strings still go through Json::dump for the exact escaping rules.
+// Byte-parity with the generic path is pinned by pbft_message_roundtrip
+// (the Python equivalence tests compare signable digests).
+void append_jstr(std::string* out, const std::string& s) {
+  *out += Json(s).dump();
+}
+
+bool signable_fast(const Message& m, std::string* b) {
+  b->reserve(224);
+  if (auto* p = std::get_if<Prepare>(&m)) {
+    *b += "{\"digest\":";
+    append_jstr(b, p->digest);
+    *b += ",\"replica\":" + std::to_string(p->replica);
+    *b += ",\"seq\":" + std::to_string(p->seq);
+    *b += ",\"type\":\"prepare\",\"view\":" + std::to_string(p->view) + "}";
+    return true;
+  }
+  if (auto* c = std::get_if<Commit>(&m)) {
+    *b += "{\"digest\":";
+    append_jstr(b, c->digest);
+    *b += ",\"replica\":" + std::to_string(c->replica);
+    *b += ",\"seq\":" + std::to_string(c->seq);
+    *b += ",\"type\":\"commit\",\"view\":" + std::to_string(c->view) + "}";
+    return true;
+  }
+  if (auto* cp = std::get_if<Checkpoint>(&m)) {
+    *b += "{\"digest\":";
+    append_jstr(b, cp->digest);
+    *b += ",\"replica\":" + std::to_string(cp->replica);
+    *b += ",\"seq\":" + std::to_string(cp->seq);
+    *b += ",\"type\":\"checkpoint\"}";
+    return true;
+  }
+  if (auto* pp = std::get_if<PrePrepare>(&m)) {
+    *b += "{\"digest\":";
+    append_jstr(b, pp->digest);
+    *b += ",\"replica\":" + std::to_string(pp->replica);
+    *b += ",\"request\":{\"client\":";
+    append_jstr(b, pp->request.client);
+    *b += ",\"operation\":";
+    append_jstr(b, pp->request.operation);
+    *b += ",\"timestamp\":" + std::to_string(pp->request.timestamp);
+    *b += "},\"seq\":" + std::to_string(pp->seq);
+    *b += ",\"type\":\"pre-prepare\",\"view\":" + std::to_string(pp->view) +
+          "}";
+    return true;
+  }
+  if (auto* r = std::get_if<ClientRequest>(&m)) {
+    *b += "{\"client\":";
+    append_jstr(b, r->client);
+    *b += ",\"operation\":";
+    append_jstr(b, r->operation);
+    *b += ",\"timestamp\":" + std::to_string(r->timestamp);
+    *b += ",\"type\":\"client-request\"}";
+    return true;
+  }
+  return false;
+}
+
+}  // namespace
+
 void message_signable(const Message& m, uint8_t out[32]) {
+  std::string fast;
+  if (signable_fast(m, &fast)) {
+    blake2b_256(out, (const uint8_t*)fast.data(), fast.size());
+    return;
+  }
   Json j = message_to_json(m);
   j.as_object().erase("sig");
   std::string bytes = j.dump();
   blake2b_256(out, (const uint8_t*)bytes.data(), bytes.size());
+}
+
+namespace {
+
+// Locate the top-level `"sig":"..."` member of a canonical JSON object.
+// Quotes inside JSON string values are always escaped, so an unescaped
+// `"sig":"` at object depth 1 is the real key; the hex value contains no
+// quotes, so the next '"' closes it. Any ambiguity (duplicate keys,
+// non-canonical input) ends in a digest that matches no honest signable —
+// the signature check fails closed.
+bool find_top_level_sig(const std::string& s, size_t* begin, size_t* end) {
+  int depth = 0;
+  bool in_str = false, esc = false;
+  for (size_t i = 0; i < s.size(); ++i) {
+    char c = s[i];
+    if (in_str) {
+      if (esc) {
+        esc = false;
+      } else if (c == '\\') {
+        esc = true;
+      } else if (c == '"') {
+        in_str = false;
+      }
+      continue;
+    }
+    if (c == '{' || c == '[') {
+      ++depth;
+    } else if (c == '}' || c == ']') {
+      --depth;
+    } else if (c == '"') {
+      if (depth == 1 && s.compare(i, 7, "\"sig\":\"") == 0) {
+        size_t vend = s.find('"', i + 7);
+        if (vend == std::string::npos) return false;
+        *begin = i;
+        *end = vend + 1;
+        return true;
+      }
+      in_str = true;
+    }
+  }
+  return false;
+}
+
+}  // namespace
+
+void message_signable_from_payload(const std::string& payload,
+                                   const Message& m, uint8_t out[32]) {
+  if (!payload.empty() && payload[0] == '{') {
+    // Splice only for types whose "sig" member is uniquely top-level:
+    // view-change/new-view evidence nests signed dicts, so those fall
+    // back to the generic derivation (they are rare by construction).
+    MsgType t = type_of(m);
+    if (t == MsgType::kPrePrepare || t == MsgType::kPrepare ||
+        t == MsgType::kCommit || t == MsgType::kCheckpoint ||
+        t == MsgType::kStateRequest || t == MsgType::kStateResponse) {
+      size_t b = 0, e = 0;
+      if (find_top_level_sig(payload, &b, &e) && b > 0 &&
+          payload[b - 1] == ',') {
+        std::string tmp;
+        tmp.reserve(payload.size());
+        tmp.append(payload, 0, b - 1);
+        tmp.append(payload, e, payload.size() - e);
+        blake2b_256(out, (const uint8_t*)tmp.data(), tmp.size());
+        return;
+      }
+    }
+  }
+  message_signable(m, out);
 }
 
 namespace {
@@ -275,6 +414,183 @@ std::optional<Message> message_from_json(const Json& j) {
   return std::nullopt;
 }
 
+namespace {
+
+enum : uint8_t {
+  kBinClientRequest = 0x01,
+  kBinPrePrepare = 0x02,
+  kBinPrepare = 0x03,
+  kBinCommit = 0x04,
+  kBinCheckpoint = 0x05,
+};
+
+void put_i64(std::string* o, int64_t v) {
+  uint64_t u = (uint64_t)v;
+  for (int i = 7; i >= 0; --i) o->push_back((char)(u >> (8 * i)));
+}
+
+void put_str(std::string* o, const std::string& s) {
+  uint32_t n = (uint32_t)s.size();
+  for (int i = 3; i >= 0; --i) o->push_back((char)(n >> (8 * i)));
+  *o += s;
+}
+
+bool put_hex(std::string* o, const std::string& hex, size_t n) {
+  uint8_t raw[64];
+  if (n > sizeof(raw) || !from_hex(hex, raw, n)) return false;
+  o->append((const char*)raw, n);
+  return true;
+}
+
+// Bounds-checked big-endian reader for the fixed layouts above. Strings
+// are capped at the frame limit; any short read flips `ok` and the
+// decoder rejects the payload.
+struct BinReader {
+  const uint8_t* p;
+  size_t n;
+  size_t off;
+  bool ok = true;
+
+  bool need(size_t k) {
+    if (!ok || n - off < k) {
+      ok = false;
+      return false;
+    }
+    return true;
+  }
+  int64_t i64() {
+    if (!need(8)) return 0;
+    uint64_t u = 0;
+    for (int i = 0; i < 8; ++i) u = (u << 8) | p[off++];
+    return (int64_t)u;
+  }
+  std::string str() {
+    if (!need(4)) return {};
+    uint32_t k = 0;
+    for (int i = 0; i < 4; ++i) k = (k << 8) | p[off++];
+    if (k > (1u << 24) || !need(k)) {
+      ok = false;
+      return {};
+    }
+    std::string s((const char*)p + off, k);
+    off += k;
+    return s;
+  }
+  std::string hex(size_t k) {
+    if (!need(k)) return {};
+    std::string h = to_hex(p + off, k);
+    off += k;
+    return h;
+  }
+};
+
+}  // namespace
+
+bool message_to_binary(const Message& m, std::string* out) {
+  std::string b;
+  b.push_back((char)kBinaryMagic);
+  if (auto* r = std::get_if<ClientRequest>(&m)) {
+    b.push_back((char)kBinClientRequest);
+    put_str(&b, r->operation);
+    put_i64(&b, r->timestamp);
+    put_str(&b, r->client);
+  } else if (auto* pp = std::get_if<PrePrepare>(&m)) {
+    b.push_back((char)kBinPrePrepare);
+    put_i64(&b, pp->view);
+    put_i64(&b, pp->seq);
+    if (!put_hex(&b, pp->digest, 32)) return false;
+    put_i64(&b, pp->replica);
+    if (!put_hex(&b, pp->sig, 64)) return false;
+    put_str(&b, pp->request.operation);
+    put_i64(&b, pp->request.timestamp);
+    put_str(&b, pp->request.client);
+  } else if (auto* p = std::get_if<Prepare>(&m)) {
+    b.push_back((char)kBinPrepare);
+    put_i64(&b, p->view);
+    put_i64(&b, p->seq);
+    if (!put_hex(&b, p->digest, 32)) return false;
+    put_i64(&b, p->replica);
+    if (!put_hex(&b, p->sig, 64)) return false;
+  } else if (auto* c = std::get_if<Commit>(&m)) {
+    b.push_back((char)kBinCommit);
+    put_i64(&b, c->view);
+    put_i64(&b, c->seq);
+    if (!put_hex(&b, c->digest, 32)) return false;
+    put_i64(&b, c->replica);
+    if (!put_hex(&b, c->sig, 64)) return false;
+  } else if (auto* cp = std::get_if<Checkpoint>(&m)) {
+    b.push_back((char)kBinCheckpoint);
+    put_i64(&b, cp->seq);
+    if (!put_hex(&b, cp->digest, 32)) return false;
+    put_i64(&b, cp->replica);
+    if (!put_hex(&b, cp->sig, 64)) return false;
+  } else {
+    return false;
+  }
+  *out = std::move(b);
+  return true;
+}
+
+std::optional<Message> message_from_binary(const std::string& payload) {
+  if (payload.size() < 2 || (uint8_t)payload[0] != kBinaryMagic) {
+    return std::nullopt;
+  }
+  BinReader r{(const uint8_t*)payload.data(), payload.size(), 2};
+  Message out;
+  switch ((uint8_t)payload[1]) {
+    case kBinClientRequest: {
+      ClientRequest m;
+      m.operation = r.str();
+      m.timestamp = r.i64();
+      m.client = r.str();
+      out = std::move(m);
+      break;
+    }
+    case kBinPrePrepare: {
+      PrePrepare m;
+      m.view = r.i64();
+      m.seq = r.i64();
+      m.digest = r.hex(32);
+      m.replica = r.i64();
+      m.sig = r.hex(64);
+      m.request.operation = r.str();
+      m.request.timestamp = r.i64();
+      m.request.client = r.str();
+      out = std::move(m);
+      break;
+    }
+    case kBinPrepare:
+    case kBinCommit: {
+      Prepare m;
+      m.view = r.i64();
+      m.seq = r.i64();
+      m.digest = r.hex(32);
+      m.replica = r.i64();
+      m.sig = r.hex(64);
+      if ((uint8_t)payload[1] == kBinPrepare) {
+        out = std::move(m);
+      } else {
+        out = Commit{m.view, m.seq, m.digest, m.replica, m.sig};
+      }
+      break;
+    }
+    case kBinCheckpoint: {
+      Checkpoint m;
+      m.seq = r.i64();
+      m.digest = r.hex(32);
+      m.replica = r.i64();
+      m.sig = r.hex(64);
+      out = std::move(m);
+      break;
+    }
+    default:
+      return std::nullopt;
+  }
+  // Strict: short reads and trailing bytes both reject the frame.
+  if (!r.ok || r.off != payload.size()) return std::nullopt;
+  return out;
+}
+
 std::string to_wire(const Message& m) {
   std::string payload = message_canonical(m);
   std::string frame;
@@ -289,6 +605,9 @@ std::string to_wire(const Message& m) {
 }
 
 std::optional<Message> from_payload(const std::string& payload) {
+  if (!payload.empty() && (uint8_t)payload[0] == kBinaryMagic) {
+    return message_from_binary(payload);
+  }
   auto j = Json::parse(payload);
   if (!j) return std::nullopt;
   return message_from_json(*j);

@@ -154,9 +154,47 @@ std::string message_canonical(const Message& m);
 void message_signable(const Message& m, uint8_t out[32]);
 std::optional<Message> message_from_json(const Json& j);
 
+// --- Binary hot-message codec v2 (negotiated per link via the hello;
+// byte-identical to pbft_tpu/consensus/messages.py to_binary/from_binary,
+// pinned by tests/test_wire_codec.py).
+//
+//   payload := 0xB2 | type:u8 | fields
+//   i64    -> 8 bytes big-endian (two's complement)
+//   str    -> u32 big-endian length + UTF-8 bytes
+//   digest -> 32 raw bytes (64 hex chars in the JSON codec)
+//   sig    -> 64 raw bytes (128 hex chars in the JSON codec)
+//
+//   0x01 client-request: operation:str | timestamp:i64 | client:str
+//   0x02 pre-prepare:    view:i64 | seq:i64 | digest | replica:i64 | sig
+//                        | operation:str | timestamp:i64 | client:str
+//   0x03 prepare:        view:i64 | seq:i64 | digest | replica:i64 | sig
+//   0x04 commit:         view:i64 | seq:i64 | digest | replica:i64 | sig
+//   0x05 checkpoint:     seq:i64 | digest | replica:i64 | sig
+//
+// Signatures still cover the canonical-JSON signable digest, so one signed
+// message re-encodes for mixed-codec fan-out without re-signing.
+inline constexpr uint8_t kBinaryMagic = 0xB2;
+inline constexpr const char* kCodecBinary2 = "bin2";
+
+// Encodes the hot normal-case types; returns false (out untouched) for
+// any other type, or when a digest/sig field is not the fixed-width hex
+// the layout requires — the caller falls back to the JSON codec.
+bool message_to_binary(const Message& m, std::string* out);
+std::optional<Message> message_from_binary(const std::string& payload);
+
+// Signable digest straight from a received framed payload: canonical JSON
+// payloads splice out the top-level "sig" member and hash the remaining
+// bytes instead of parse -> re-serialize -> hash; everything else (binary
+// payloads, nested-sig types, non-canonical input) falls back to
+// message_signable. tests/test_wire_codec.py pins that both derivations
+// agree on every message type.
+void message_signable_from_payload(const std::string& payload,
+                                   const Message& m, uint8_t out[32]);
+
 // Wire framing: u32 big-endian length prefix + canonical JSON.
 std::string to_wire(const Message& m);
-// Parses a complete frame payload (without the length prefix).
+// Parses a complete frame payload (without the length prefix); payloads
+// opening with kBinaryMagic decode via the binary-v2 codec.
 std::optional<Message> from_payload(const std::string& payload);
 
 // hex helpers

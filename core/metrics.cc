@@ -21,6 +21,11 @@ const char* kCounterNames[] = {
     "pbft_view_changes_total",       "pbft_verify_batches_total",
     "pbft_verify_items_total",       "pbft_verify_rejected_total",
     "pbft_verify_deadline_fired_total",
+    // Wire-codec surface: outbound frames per payload codec, plus the
+    // serialize-once invariant counter (encodes per broadcast, never per
+    // peer — tests compare it against the broadcast count).
+    "pbft_codec_binary_frames_total", "pbft_codec_json_frames_total",
+    "pbft_broadcast_encodes_total",
 };
 const char* kGaugeNames[] = {
     "pbft_verify_queue_depth",

@@ -410,6 +410,11 @@ bool ReplicaServer::handle_peer_frame(Conn& c, std::string payload) {
       if (!j) return fail_conn(c, "malformed handshake reply");
       auto auth = c.chan->on_hello_reply(*j);
       if (!auth) return fail_conn(c, c.chan->error());
+      // hello_r carries the responder's codec offer: binary-v2 from here
+      // on when both sides speak it (sends queued pre-handshake were
+      // already JSON-encoded; mixed frames on one link are fine — the
+      // receiver detects the codec per frame).
+      c.codec_binary = hello_offers_binary(*j);
       c.wbuf += frame_payload(*auth);
       for (auto& p : c.pending)
         c.wbuf += frame_payload(c.chan->seal_frame(p));
@@ -417,7 +422,7 @@ bool ReplicaServer::handle_peer_frame(Conn& c, std::string payload) {
       flush(c);
       return !c.closed;
     }
-    if (!c.chan) {  // plaintext link: honor a version reject, ignore rest
+    if (!c.chan) {  // plaintext link: hello-ack (codec offer) or reject
       auto j = Json::parse(payload);
       const Json* t = j ? j->find("type") : nullptr;
       if (t && t->is_string() && t->as_string() == "reject") {
@@ -425,6 +430,9 @@ bool ReplicaServer::handle_peer_frame(Conn& c, std::string payload) {
         return fail_conn(c, "peer rejected link: " +
                                 (r && r->is_string() ? r->as_string()
                                                      : "<no reason>"));
+      }
+      if (t && t->is_string() && t->as_string() == "hello") {
+        c.codec_binary = hello_offers_binary(*j);
       }
       return true;
     }
@@ -446,6 +454,12 @@ bool ReplicaServer::handle_peer_frame(Conn& c, std::string payload) {
         auto reply = c.chan->on_hello(*j);
         if (!reply) return reject_conn(c, c.chan->error());
         c.wbuf += frame_payload(*reply);
+        flush(c);
+      } else {
+        // Plaintext hello-ack: advertise this node's version + codec
+        // offer so the dialing peer can negotiate binary-v2 (a 1.0.0
+        // initiator parses and ignores any non-reject frame).
+        c.wbuf += frame_payload(SecureChannel::plain_hello(id_));
         flush(c);
       }
       return !c.closed;
@@ -472,7 +486,16 @@ bool ReplicaServer::handle_peer_frame(Conn& c, std::string payload) {
   if (msg) {
     ++frames_in_;
     metrics_.inc("pbft_frames_in_total");
-    emit(replica_->receive(*msg));
+    if (std::holds_alternative<ClientRequest>(*msg)) {
+      emit(replica_->receive(*msg));
+    } else {
+      // Receive-side canonical reuse: derive the signable digest from
+      // the framed bytes we already hold (sig-splice for JSON, fixed
+      // template for binary) so the verify queue never re-serializes.
+      uint8_t signable[32];
+      message_signable_from_payload(payload, *msg, signable);
+      emit(replica_->receive(*msg, signable));
+    }
   }
   return true;
 }
@@ -798,11 +821,47 @@ void ReplicaServer::finish_verify_async() {
   }
 }
 
+namespace {
+template <class T, class = void>
+struct has_sig : std::false_type {};
+template <class T>
+struct has_sig<T, std::void_t<decltype(std::declval<T&>().sig)>>
+    : std::true_type {};
+
+// The Byzantine signer's outgoing message: same content, garbage
+// signature (mirrors the simulation mutator in bench/harness.py).
+Message corrupt_sig(Message m) {
+  std::visit(
+      [](auto& v) {
+        if constexpr (has_sig<std::decay_t<decltype(v)>>::value) {
+          if (!v.sig.empty()) v.sig.assign(v.sig.size(), 'f');
+        }
+      },
+      m);
+  return m;
+}
+}  // namespace
+
 void ReplicaServer::emit(Actions&& actions) {
   for (auto& b : actions.broadcasts) {
-    for (int64_t dest = 0; dest < cfg_.n(); ++dest) {
-      if (dest != id_) send_to(dest, b.msg);
+    // Serialize-once fan-out: ONE canonical encode (and at most one
+    // binary-v2 encode, when any link negotiated it) per broadcast,
+    // shared across every destination — the per-peer loop is pick codec,
+    // seal (secure links), memcpy, flush. The Byzantine corruption is
+    // applied once too: every peer sees the same garbage signature.
+    Message corrupted;
+    const Message* mp = &b.msg;
+    if (byzantine_) {
+      corrupted = corrupt_sig(b.msg);
+      mp = &corrupted;
     }
+    EncodedOut enc(mp);
+    for (int64_t dest = 0; dest < cfg_.n(); ++dest) {
+      if (dest != id_) send_encoded(dest, enc);
+    }
+    ++broadcasts_;
+    broadcast_encodes_ += enc.encodes;
+    metrics_.inc("pbft_broadcast_encodes_total", enc.encodes);
   }
   for (auto& s : actions.sends) {
     // A ClientRequest forwarded to the primary starts this replica's
@@ -922,27 +981,6 @@ int ReplicaServer::peer_fd(int64_t dest) {
   return fd;
 }
 
-namespace {
-template <class T, class = void>
-struct has_sig : std::false_type {};
-template <class T>
-struct has_sig<T, std::void_t<decltype(std::declval<T&>().sig)>>
-    : std::true_type {};
-
-// The Byzantine signer's outgoing message: same content, garbage
-// signature (mirrors the simulation mutator in bench/harness.py).
-Message corrupt_sig(Message m) {
-  std::visit(
-      [](auto& v) {
-        if constexpr (has_sig<std::decay_t<decltype(v)>>::value) {
-          if (!v.sig.empty()) v.sig.assign(v.sig.size(), 'f');
-        }
-      },
-      m);
-  return m;
-}
-}  // namespace
-
 void ReplicaServer::send_to(int64_t dest, const Message& m) {
   if (dest == id_) {
     // Self-delivery bypasses the wire AND the corruption: a Byzantine
@@ -950,20 +988,39 @@ void ReplicaServer::send_to(int64_t dest, const Message& m) {
     emit(replica_->receive(m));
     return;
   }
+  Message corrupted;
+  const Message* mp = &m;
+  if (byzantine_) {
+    corrupted = corrupt_sig(m);
+    mp = &corrupted;
+  }
+  EncodedOut enc(mp);
+  send_encoded(dest, enc);
+}
+
+void ReplicaServer::send_encoded(int64_t dest, EncodedOut& enc) {
   if (peer_fd(dest) < 0) return;  // peer down: PBFT tolerates f of these
   Conn& c = *peers_[dest];
-  std::string payload = message_canonical(byzantine_ ? corrupt_sig(m) : m);
+  const std::string* payload = nullptr;
+  if (c.codec_binary) payload = enc.binary_payload();
+  const bool bin = payload != nullptr;
+  if (!bin) payload = &enc.json_payload();
+  metrics_.inc(bin ? "pbft_codec_binary_frames_total"
+                   : "pbft_codec_json_frames_total");
   if (cfg_.secure) {
     if (!c.chan || !c.chan->established()) {
       // Handshake in flight: queue (bounded — a wedged handshake must not
       // buffer without limit; PBFT tolerates the loss via retransmission).
-      if (c.pending.size() < 4096) c.pending.push_back(std::move(payload));
+      if (c.pending.size() < 4096) c.pending.push_back(*payload);
       flush(c);
       return;
     }
-    payload = c.chan->seal_frame(payload);
+    // Per-peer sealing over the SHARED plaintext: the AEAD counter is
+    // per-link state, so only the seal (not the encode) runs per peer.
+    c.wbuf += frame_payload(c.chan->seal_frame(*payload));
+  } else {
+    c.wbuf += frame_payload(*payload);
   }
-  c.wbuf += frame_payload(payload);
   flush(c);
 }
 
@@ -1073,6 +1130,8 @@ std::string ReplicaServer::metrics_json() const {
   o["port"] = Json(listen_port_);
   o["frames_in"] = Json(frames_in_);
   o["verify_batches"] = Json(batches_run_);
+  o["broadcasts"] = Json(broadcasts_);
+  o["broadcast_encodes"] = Json(broadcast_encodes_);
   o["reply_backlog"] = Json((int64_t)reply_backlog_.size());
   o["replies_dropped"] = Json(replies_dropped_);
   o["verify_deadline_fired"] = Json(verify_deadline_fired_);

@@ -58,8 +58,44 @@ struct Conn {
   // handshake and seal every subsequent frame.
   int64_t peer_dest = -1;  // >= 0 on dialed (outbound) links
   bool hello_seen = false;  // inbound: version hello consumed
+  // Negotiated payload codec for this dialed link: binary-v2 once the
+  // peer's hello (plaintext hello-ack or secure hello_r) offered "bin2".
+  // Frames sent before the offer arrives go as JSON; receivers detect
+  // the codec per frame from the payload's first byte.
+  bool codec_binary = false;
   std::unique_ptr<SecureChannel> chan;
   std::vector<std::string> pending;  // outbound payloads queued pre-handshake
+};
+
+// A message mid-fan-out: canonical JSON and binary-v2 encodings are
+// computed lazily, AT MOST ONCE each, however many peers the message goes
+// to (the serialize-once invariant; `encodes` feeds
+// pbft_broadcast_encodes_total). Secure links seal per peer over the
+// shared plaintext.
+struct EncodedOut {
+  const Message* m;
+  std::string json;
+  std::string binary;
+  bool binary_tried = false;
+  bool binary_ok = false;
+  int64_t encodes = 0;
+
+  explicit EncodedOut(const Message* msg) : m(msg) {}
+  const std::string& json_payload() {
+    if (json.empty()) {
+      json = message_canonical(*m);
+      ++encodes;
+    }
+    return json;
+  }
+  const std::string* binary_payload() {
+    if (!binary_tried) {
+      binary_tried = true;
+      binary_ok = message_to_binary(*m, &binary);
+      if (binary_ok) ++encodes;
+    }
+    return binary_ok ? &binary : nullptr;
+  }
 };
 
 class ReplicaServer {
@@ -153,6 +189,9 @@ class ReplicaServer {
                         std::vector<uint8_t> verdicts);
   void emit(Actions&& actions);
   void send_to(int64_t dest, const Message& m);
+  // Shared by send_to and the broadcast fan-out: pick the link codec,
+  // reuse (or lazily compute) the encoding, seal per peer, flush.
+  void send_encoded(int64_t dest, EncodedOut& enc);
   void dial_reply(const std::string& client_addr, const ClientReply& reply);
   // Start one reply dial (nonblocking) if the in-flight budget allows,
   // else queue it in reply_backlog_.
@@ -231,6 +270,10 @@ class ReplicaServer {
   std::map<int64_t, std::unique_ptr<Conn>> peers_;  // dialed (outbound)
   int64_t batches_run_ = 0;
   int64_t frames_in_ = 0;
+  // Serialize-once accounting (metrics_json + the counter-based invariant
+  // test): encodes must track broadcasts, never broadcasts x peers.
+  int64_t broadcasts_ = 0;
+  int64_t broadcast_encodes_ = 0;
   // Bounded verify accumulation (ClusterConfig::verify_flush_us): the
   // window opens when the first item queues and flushes at the item
   // target or the deadline, whichever comes first. poll_once clamps its

@@ -105,7 +105,17 @@ Actions Replica::receive(const Message& msg) {
   if (std::holds_alternative<ClientRequest>(msg)) {
     return on_client_request(std::get<ClientRequest>(msg));
   }
-  inbox_.push_back(msg);
+  inbox_.push_back(InboxEntry{msg, false, {}});
+  return {};
+}
+
+Actions Replica::receive(const Message& msg, const uint8_t signable[32]) {
+  if (std::holds_alternative<ClientRequest>(msg)) {
+    return on_client_request(std::get<ClientRequest>(msg));
+  }
+  InboxEntry e{msg, true, {}};
+  std::memcpy(e.signable, signable, 32);
+  inbox_.push_back(std::move(e));
   return {};
 }
 
@@ -146,13 +156,20 @@ ClientRequest null_request() {
 std::vector<VerifyItem> Replica::pending_items() const {
   std::vector<VerifyItem> items;
   items.reserve(inbox_.size());
-  for (const Message& msg : inbox_) {
+  for (const InboxEntry& e : inbox_) {
+    const Message& msg = e.msg;
     VerifyItem item{};
     int64_t rid = replica_of(msg);
     if (rid >= 0 && rid < config_.n()) {
       std::memcpy(item.pub, config_.replicas[rid].pubkey, 32);
     }
-    message_signable(msg, item.msg);
+    if (e.has_signable) {
+      // Receive-side canonical reuse: the net layer already hashed the
+      // sender's framed bytes — no parse -> re-serialize -> hash here.
+      std::memcpy(item.msg, e.signable, 32);
+    } else {
+      message_signable(msg, item.msg);
+    }
     const std::string* sig = sig_of(msg);
     if (!sig || !from_hex(*sig, item.sig, 64)) {
       std::memset(item.sig, 0, 64);  // guaranteed invalid
@@ -166,7 +183,7 @@ Actions Replica::deliver_verdicts(const std::vector<uint8_t>& verdicts) {
   Actions out;
   size_t n = std::min(verdicts.size(), inbox_.size());
   for (size_t i = 0; i < n; ++i) {
-    Message msg = std::move(inbox_.front());
+    Message msg = std::move(inbox_.front().msg);
     inbox_.pop_front();
     if (!verdicts[i]) {
       counters["sig_rejected"] += 1;

@@ -93,8 +93,13 @@ class Replica {
   // contract); backups forward to the primary.
   Actions on_client_request(const ClientRequest& req);
 
-  // Replica-to-replica: queue for batched signature verification.
+  // Replica-to-replica: queue for batched signature verification. The
+  // net layer passes the signable digest it derived from the received
+  // frame bytes (messages.h message_signable_from_payload) so
+  // pending_items never re-serializes; the digest-less overload (self
+  // delivery, tests) computes it there instead.
   Actions receive(const Message& msg);
+  Actions receive(const Message& msg, const uint8_t signable[32]);
   std::vector<VerifyItem> pending_items() const;
   // Queue depth without building the items — the event loop's bounded
   // accumulation (verify_flush_us) checks this every pass.
@@ -204,7 +209,12 @@ class Replica {
   std::map<std::string, int64_t> last_timestamp_;
   std::map<std::string, ClientReply> last_reply_;
   std::map<int64_t, std::map<int64_t, Checkpoint>> checkpoints_;
-  std::deque<Message> inbox_;
+  struct InboxEntry {
+    Message msg;
+    bool has_signable = false;
+    uint8_t signable[32];
+  };
+  std::deque<InboxEntry> inbox_;
   // Checkpoint payloads we can serve to lagging peers, and the
   // (seq, digest) we are ourselves waiting to fetch after a watermark jump.
   std::map<int64_t, std::string> snapshots_;

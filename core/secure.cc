@@ -3,13 +3,40 @@
 #include <sys/random.h>
 
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
 
 #include "blake2b.h"
 #include "ed25519.h"
-#include "messages.h"  // to_hex / from_hex
+#include "messages.h"  // to_hex / from_hex / kCodecBinary2
 
 namespace pbft {
+
+namespace {
+bool wire_json_forced() {
+  static const bool forced = [] {
+    const char* v = std::getenv("PBFT_WIRE_CODEC");
+    return v != nullptr && std::strcmp(v, "json") == 0;
+  }();
+  return forced;
+}
+}  // namespace
+
+const char* wire_hello_version() {
+  return wire_json_forced() ? kProtocolVersionLegacy : kProtocolVersion;
+}
+
+bool wire_offer_binary() { return !wire_json_forced(); }
+
+bool hello_offers_binary(const Json& obj) {
+  if (!wire_offer_binary()) return false;
+  const Json* codecs = obj.find("codecs");
+  if (!codecs || !codecs->is_array()) return false;
+  for (const Json& c : codecs->as_array()) {
+    if (c.is_string() && c.as_string() == kCodecBinary2) return true;
+  }
+  return false;
+}
 
 namespace {
 
@@ -133,7 +160,8 @@ SecureChannel::SecureChannel(const ClusterConfig* cfg, int64_t my_id,
     : cfg_(cfg),
       my_id_(my_id),
       initiator_(initiator),
-      expected_peer_(expected_peer) {
+      expected_peer_(expected_peer),
+      hs_version_(wire_hello_version()) {
   std::memcpy(seed_, identity_seed, 32);
   fill_random(eph_secret_, 32);
   ed25519_dh_public(eph_pub_, eph_secret_);
@@ -142,7 +170,9 @@ SecureChannel::SecureChannel(const ClusterConfig* cfg, int64_t my_id,
 bool SecureChannel::check_version(const Json& obj, std::string* err) {
   const Json* v = obj.find("ver");
   std::string ver = v && v->is_string() ? v->as_string() : "<none>";
-  if (ver != kProtocolVersion) {
+  // Compatible set, not exact match: 1.1.0 only ADDS the negotiated
+  // binary codec, so 1.0.0 peers interoperate (JSON frames both ways).
+  if (ver != kProtocolVersion && ver != kProtocolVersionLegacy) {
     *err = "protocol version mismatch: peer speaks '" + ver +
            "', this node speaks '" + kProtocolVersion + "'";
     return false;
@@ -154,7 +184,7 @@ void SecureChannel::transcript(uint8_t out[32]) const {
   const uint8_t* eph_i = initiator_ ? eph_pub_ : peer_eph_;
   const uint8_t* eph_r = initiator_ ? peer_eph_ : eph_pub_;
   std::string data = kHsContext;
-  data += kProtocolVersion;
+  data += hs_version_;
   data += '|';
   data.append((const char*)eph_i, 32);
   data += '|';
@@ -214,12 +244,26 @@ bool SecureChannel::finish() {
   return true;
 }
 
+namespace {
+// Codec offer attached to every hello this node emits (unless JSON is
+// forced): the receiver may then send binary-v2 hot-message frames back
+// on its own dialed link, and the dialing side reads the responder's
+// offer to pick this link's codec.
+void attach_codecs(JsonObject* o) {
+  if (!wire_offer_binary()) return;
+  JsonArray codecs;
+  codecs.push_back(Json(kCodecBinary2));
+  (*o)["codecs"] = Json(std::move(codecs));
+}
+}  // namespace
+
 std::string SecureChannel::initiator_hello() {
   JsonObject o;
   o["type"] = Json("hello");
-  o["ver"] = Json(kProtocolVersion);
+  o["ver"] = Json(wire_hello_version());
   o["node"] = Json(my_id_);
   o["eph"] = Json(to_hex(eph_pub_, 32));
+  attach_codecs(&o);
   return Json(o).dump();
 }
 
@@ -233,6 +277,10 @@ std::optional<std::string> SecureChannel::on_hello(const Json& obj) {
         "(hello carried no ephemeral key)";
     return std::nullopt;
   }
+  // Responder: the transcript binds to the initiator's advertised
+  // version (check_version admitted it into the compatible set).
+  const Json* ver = obj.find("ver");
+  if (ver && ver->is_string()) hs_version_ = ver->as_string();
   have_peer_eph_ = true;
   uint8_t th[32];
   transcript(th);
@@ -242,10 +290,11 @@ std::optional<std::string> SecureChannel::on_hello(const Json& obj) {
   ed25519_sign(sig, seed_, (const uint8_t*)msg.data(), msg.size());
   JsonObject o;
   o["type"] = Json("hello");
-  o["ver"] = Json(kProtocolVersion);
+  o["ver"] = Json(wire_hello_version());
   o["node"] = Json(my_id_);
   o["eph"] = Json(to_hex(eph_pub_, 32));
   o["sig"] = Json(to_hex(sig, 64));
+  attach_codecs(&o);
   return Json(o).dump();
 }
 
@@ -309,15 +358,16 @@ std::string SecureChannel::reject_payload(const std::string& reason) {
   JsonObject o;
   o["type"] = Json("reject");
   o["reason"] = Json(reason);
-  o["ver"] = Json(kProtocolVersion);
+  o["ver"] = Json(wire_hello_version());
   return Json(o).dump();
 }
 
 std::string SecureChannel::plain_hello(int64_t my_id) {
   JsonObject o;
   o["type"] = Json("hello");
-  o["ver"] = Json(kProtocolVersion);
+  o["ver"] = Json(wire_hello_version());
   o["node"] = Json(my_id);
+  attach_codecs(&o);
   return Json(o).dump();
 }
 

@@ -18,8 +18,22 @@
 
 namespace pbft {
 
-inline constexpr const char* kProtocolVersion = "pbft-tpu/1.0.0";
+// 1.1.0 adds the negotiated binary-v2 payload codec (core/messages.h);
+// 1.0.0 peers stay interoperable — the hello's ver gates what a sender
+// may offer, and the transcript binds to the initiator's advertised
+// version so mixed-version secure handshakes still agree on the bytes.
+inline constexpr const char* kProtocolVersion = "pbft-tpu/1.1.0";
+inline constexpr const char* kProtocolVersionLegacy = "pbft-tpu/1.0.0";
 inline constexpr size_t kTagLen = 16;
+
+// The hello this node sends: kProtocolVersion with codecs ["bin2"], or
+// the legacy 1.0.0 JSON-only hello when PBFT_WIRE_CODEC=json (the
+// mixed-cluster escape hatch and the interop-test lever).
+const char* wire_hello_version();
+bool wire_offer_binary();
+// True when a peer's hello offers the binary-v2 codec (and this node
+// offers it too): the sender may then encode hot messages as binary.
+bool hello_offers_binary(const Json& obj);
 
 // Keystream/tag primitive: sealed = ciphertext || 16B tag. key is 64 bytes
 // (enc 32 || mac 32); ctr is the per-direction frame counter.
@@ -83,6 +97,11 @@ class SecureChannel {
   uint64_t send_ctr_ = 0;
   uint64_t recv_ctr_ = 0;
   bool established_ = false;
+  // The transcript binds to the INITIATOR's advertised version (both
+  // sides know it after hello_i), so 1.1.0 <-> 1.0.0 handshakes agree on
+  // the signed bytes. Initiator: the version it sent; responder: set
+  // from hello_i in on_hello.
+  std::string hs_version_;
   std::string error_;
 };
 

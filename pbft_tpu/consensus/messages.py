@@ -56,7 +56,14 @@ class Message:
         return _canonical_json(self.to_dict())
 
     def signable(self) -> bytes:
-        """32-byte digest of the content excluding the signature field."""
+        """32-byte digest of the content excluding the signature field.
+
+        Hot message types render through a fixed template (byte-identical
+        to the generic sorted-keys dump; tests/test_wire_codec.py pins the
+        parity) — the generic path pays dataclasses.asdict per call."""
+        fast = _signable_bytes_fast(self)
+        if fast is not None:
+            return blake2b_256(fast)
         d = self.to_dict()
         d.pop("sig", None)
         return blake2b_256(_canonical_json(d))
@@ -287,3 +294,270 @@ class StateResponse(Message):
 
 def with_sig(msg: Message, sig_hex: str) -> Message:
     return dataclasses.replace(msg, sig=sig_hex)
+
+
+# -- fast signable templates -------------------------------------------------
+#
+# The generic signable path costs a recursive dataclasses.asdict plus a
+# sorted-keys dumps per message; the hot types have a fixed key order, so
+# their canonical signable bytes render directly. Strings go through
+# json.dumps for the exact escaping; int fields are guarded with
+# `type(x) is int` because a stray bool would render "True" where the
+# generic path emits "true" — mismatched types fall back to the generic
+# derivation instead of diverging.
+
+_dumps = json.dumps
+
+
+def _signable_bytes_fast(msg: "Message") -> Optional[bytes]:
+    t = msg.__class__
+    if t is Prepare or t is Commit:
+        v, s, d, r = msg.view, msg.seq, msg.digest, msg.replica
+        if (
+            type(v) is int and type(s) is int and type(r) is int
+            and type(d) is str
+        ):
+            return (
+                f'{{"digest":{_dumps(d)},"replica":{r},"seq":{s},'
+                f'"type":"{t.TYPE}","view":{v}}}'
+            ).encode()
+        return None
+    if t is Checkpoint:
+        s, d, r = msg.seq, msg.digest, msg.replica
+        if type(s) is int and type(r) is int and type(d) is str:
+            return (
+                f'{{"digest":{_dumps(d)},"replica":{r},"seq":{s},'
+                f'"type":"checkpoint"}}'
+            ).encode()
+        return None
+    if t is PrePrepare:
+        req = msg.request
+        if (
+            type(msg.view) is int and type(msg.seq) is int
+            and type(msg.replica) is int and type(msg.digest) is str
+            and type(req) is ClientRequest and type(req.timestamp) is int
+            and type(req.operation) is str and type(req.client) is str
+        ):
+            return (
+                f'{{"digest":{_dumps(msg.digest)},"replica":{msg.replica},'
+                f'"request":{{"client":{_dumps(req.client)},'
+                f'"operation":{_dumps(req.operation)},'
+                f'"timestamp":{req.timestamp}}},"seq":{msg.seq},'
+                f'"type":"pre-prepare","view":{msg.view}}}'
+            ).encode()
+        return None
+    if t is ClientRequest:
+        if (
+            type(msg.timestamp) is int and type(msg.operation) is str
+            and type(msg.client) is str
+        ):
+            return (
+                f'{{"client":{_dumps(msg.client)},'
+                f'"operation":{_dumps(msg.operation)},'
+                f'"timestamp":{msg.timestamp},"type":"client-request"}}'
+            ).encode()
+        return None
+    return None
+
+
+# -- receive-side canonical reuse --------------------------------------------
+
+# Types whose "sig" member is uniquely top-level in the canonical JSON —
+# view-change/new-view evidence nests signed dicts, so those always take
+# the generic derivation (they are rare by construction).
+_SPLICE_TYPES = None  # filled below, after the dataclasses exist
+
+
+def signable_from_payload(payload: bytes, msg: Message) -> bytes:
+    """Signable digest straight from a received framed payload.
+
+    For canonical JSON payloads of the hot types, splice out the
+    top-level ``"sig"`` member and hash the remaining bytes instead of
+    re-serializing the parsed message. Quotes inside JSON string values
+    are always escaped, so the first raw ``,"sig":"`` is the real key;
+    any ambiguity (duplicate keys, non-canonical input) yields a digest
+    matching no honest signable — the signature check fails closed.
+    Everything else (binary payloads, nested-sig types) falls back to
+    ``msg.signable()``. tests/test_wire_codec.py pins that the two
+    derivations agree for every message type."""
+    if payload[:1] == b"{" and type(msg) in _SPLICE_TYPES:
+        i = payload.find(b',"sig":"')
+        if i >= 0:
+            j = payload.find(b'"', i + 8)
+            if j >= 0:
+                return blake2b_256(payload[:i] + payload[j + 1 :])
+    return msg.signable()
+
+
+# -- binary hot-message codec v2 ---------------------------------------------
+#
+# Negotiated per link via the version-carrying hello (net/secure.py);
+# byte-identical to core/messages.cc message_to_binary/from_binary
+# (pinned by the cross-runtime fuzz in tests/test_wire_codec.py).
+#
+#   payload := 0xB2 | type:u8 | fields
+#   i64    -> 8 bytes big-endian (two's complement)
+#   str    -> u32 big-endian length + UTF-8 bytes
+#   digest -> 32 raw bytes (64 hex chars in the JSON codec)
+#   sig    -> 64 raw bytes (128 hex chars in the JSON codec)
+#
+# Signatures still cover the canonical-JSON signable digest, so a signed
+# message re-encodes for mixed-codec fan-out without re-signing.
+
+WIRE_BINARY_MAGIC = 0xB2
+CODEC_BINARY2 = "bin2"
+
+_BIN_CLIENT_REQUEST = 0x01
+_BIN_PRE_PREPARE = 0x02
+_BIN_PREPARE = 0x03
+_BIN_COMMIT = 0x04
+_BIN_CHECKPOINT = 0x05
+
+
+def _i64(v: int) -> bytes:
+    return v.to_bytes(8, "big", signed=True)
+
+
+def _b_str(s: str) -> bytes:
+    b = s.encode()
+    return len(b).to_bytes(4, "big") + b
+
+
+def _b_hex(h: str, n: int) -> Optional[bytes]:
+    if type(h) is not str or len(h) != 2 * n:
+        return None
+    try:
+        return bytes.fromhex(h)
+    except ValueError:
+        return None
+
+
+def to_binary(msg: Message) -> Optional[bytes]:
+    """Binary-v2 encoding of the hot normal-case types; None for any
+    other type or a digest/sig field that is not fixed-width hex — the
+    caller falls back to the JSON codec."""
+    t = msg.__class__
+    try:
+        if t is ClientRequest:
+            return (
+                bytes((WIRE_BINARY_MAGIC, _BIN_CLIENT_REQUEST))
+                + _b_str(msg.operation) + _i64(msg.timestamp)
+                + _b_str(msg.client)
+            )
+        if t is PrePrepare:
+            digest = _b_hex(msg.digest, 32)
+            sig = _b_hex(msg.sig, 64)
+            if digest is None or sig is None:
+                return None
+            req = msg.request
+            return (
+                bytes((WIRE_BINARY_MAGIC, _BIN_PRE_PREPARE))
+                + _i64(msg.view) + _i64(msg.seq) + digest
+                + _i64(msg.replica) + sig
+                + _b_str(req.operation) + _i64(req.timestamp)
+                + _b_str(req.client)
+            )
+        if t is Prepare or t is Commit:
+            digest = _b_hex(msg.digest, 32)
+            sig = _b_hex(msg.sig, 64)
+            if digest is None or sig is None:
+                return None
+            code = _BIN_PREPARE if t is Prepare else _BIN_COMMIT
+            return (
+                bytes((WIRE_BINARY_MAGIC, code))
+                + _i64(msg.view) + _i64(msg.seq) + digest
+                + _i64(msg.replica) + sig
+            )
+        if t is Checkpoint:
+            digest = _b_hex(msg.digest, 32)
+            sig = _b_hex(msg.sig, 64)
+            if digest is None or sig is None:
+                return None
+            return (
+                bytes((WIRE_BINARY_MAGIC, _BIN_CHECKPOINT))
+                + _i64(msg.seq) + digest + _i64(msg.replica) + sig
+            )
+    except (OverflowError, AttributeError, UnicodeEncodeError):
+        return None
+    return None
+
+
+class _BinReader:
+    __slots__ = ("b", "off")
+
+    def __init__(self, b: bytes, off: int):
+        self.b = b
+        self.off = off
+
+    def _take(self, n: int) -> bytes:
+        end = self.off + n
+        if end > len(self.b):
+            raise ValueError("truncated binary frame")
+        out = self.b[self.off : end]
+        self.off = end
+        return out
+
+    def i64(self) -> int:
+        return int.from_bytes(self._take(8), "big", signed=True)
+
+    def str_(self) -> str:
+        n = int.from_bytes(self._take(4), "big")
+        if n > (1 << 24):
+            raise ValueError("oversized string in binary frame")
+        return self._take(n).decode()
+
+    def hex_(self, n: int) -> str:
+        return self._take(n).hex()
+
+
+def from_binary(payload: bytes) -> Message:
+    """Decode a binary-v2 payload; raises ValueError on any malformation
+    (short reads, trailing bytes, unknown type, invalid UTF-8)."""
+    if len(payload) < 2 or payload[0] != WIRE_BINARY_MAGIC:
+        raise ValueError("not a binary-v2 payload")
+    r = _BinReader(payload, 2)
+    code = payload[1]
+    if code == _BIN_CLIENT_REQUEST:
+        msg: Message = ClientRequest(
+            operation=r.str_(), timestamp=r.i64(), client=r.str_()
+        )
+    elif code == _BIN_PRE_PREPARE:
+        view, seq = r.i64(), r.i64()
+        digest = r.hex_(32)
+        replica = r.i64()
+        sig = r.hex_(64)
+        req = ClientRequest(
+            operation=r.str_(), timestamp=r.i64(), client=r.str_()
+        )
+        msg = PrePrepare(
+            view=view, seq=seq, digest=digest, request=req,
+            replica=replica, sig=sig,
+        )
+    elif code in (_BIN_PREPARE, _BIN_COMMIT):
+        cls = Prepare if code == _BIN_PREPARE else Commit
+        msg = cls(
+            view=r.i64(), seq=r.i64(), digest=r.hex_(32),
+            replica=r.i64(), sig=r.hex_(64),
+        )
+    elif code == _BIN_CHECKPOINT:
+        msg = Checkpoint(
+            seq=r.i64(), digest=r.hex_(32), replica=r.i64(), sig=r.hex_(64)
+        )
+    else:
+        raise ValueError(f"unknown binary message type {code:#x}")
+    if r.off != len(payload):
+        raise ValueError("trailing bytes in binary frame")
+    return msg
+
+
+def decode_payload(payload: bytes) -> Message:
+    """Decode a framed payload of either codec (binary-v2 when it opens
+    with the magic byte, canonical JSON otherwise)."""
+    if payload[:1] == bytes((WIRE_BINARY_MAGIC,)):
+        return from_binary(payload)
+    return from_wire(payload)
+
+
+_SPLICE_TYPES = (
+    PrePrepare, Prepare, Commit, Checkpoint, StateRequest, StateResponse
+)

@@ -146,7 +146,8 @@ class Replica:
         self.pending_view = 0
         self.view_changes: Dict[int, Dict[int, ViewChange]] = {}
         self.new_view_sent: Set[int] = set()
-        self._inbox: List[Message] = []
+        # (message, optional precomputed signable digest) — see receive().
+        self._inbox: List[Tuple[Message, Optional[bytes]]] = []
         # Consensus-phase observer (utils.metrics.ConsensusSpans.on_phase):
         # called as hook(phase, view, seq) at each protocol transition. The
         # state machine itself stays clock-free and deterministic — the
@@ -228,14 +229,19 @@ class Replica:
 
     # -- signature gating ---------------------------------------------------
 
-    def receive(self, msg: Message) -> List[Action]:
+    def receive(
+        self, msg: Message, signable: Optional[bytes] = None
+    ) -> List[Action]:
         """Queue a replica-to-replica message for batched verification.
 
         ClientRequests skip the queue (clients are unauthenticated, matching
-        the reference's client contract)."""
+        the reference's client contract). ``signable`` is the 32-byte
+        signable digest the net layer derived from the received frame
+        bytes (messages.signable_from_payload) — when present,
+        pending_items reuses it instead of re-serializing."""
         if isinstance(msg, ClientRequest):
             return self.on_client_request(msg)
-        self._inbox.append(msg)
+        self._inbox.append((msg, signable))
         return []
 
     def pending_count(self) -> int:
@@ -247,7 +253,7 @@ class Replica:
         """(pubkey32, digest32, sig64) per queued message, for the batch
         verifier (pbft_tpu.crypto.batch.verify_many or the TPU service)."""
         items = []
-        for msg in self._inbox:
+        for msg, signable in self._inbox:
             rid = getattr(msg, "replica", None)
             pub = (
                 self.config.identity(rid).pubkey_bytes()
@@ -260,14 +266,16 @@ class Replica:
                 sig = b""
             if len(sig) != 64:
                 sig = bytes(64)  # guaranteed-invalid placeholder
-            items.append((pub, msg.signable(), sig))
+            # Receive-side canonical reuse: the net layer already hashed
+            # the sender's framed bytes — no re-serialization here.
+            items.append((pub, signable or msg.signable(), sig))
         return items
 
     def deliver_verdicts(self, verdicts: List[bool]) -> List[Action]:
         """Resume processing for the queued messages, in arrival order."""
         batch, self._inbox = self._inbox[: len(verdicts)], self._inbox[len(verdicts) :]
         out: List[Action] = []
-        for msg, ok in zip(batch, verdicts):
+        for (msg, _), ok in zip(batch, verdicts):
             if not ok:
                 self.counters["sig_rejected"] += 1
                 continue

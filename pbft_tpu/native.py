@@ -197,3 +197,51 @@ def force_entropy_exhaustion(on: bool) -> None:
     """TEST hook: simulate entropy exhaustion so the RLC fast path
     disables and windows verify per-item (ADVICE round-5 regression)."""
     lib().pbft_test_force_entropy_exhaustion(ctypes.c_int(1 if on else 0))
+
+
+def pubkey_cache_clear() -> None:
+    """Drop every entry in the native per-key decompressed-point cache."""
+    lib().pbft_pubkey_cache_clear()
+
+
+def pubkey_cache_disable(on: bool) -> None:
+    """TEST hook: force the cold (uncached) pubkey-decompression path so
+    parity tests can compare warm vs cold verdicts."""
+    lib().pbft_test_pubkey_cache_disable(ctypes.c_int(1 if on else 0))
+
+
+def message_to_binary(payload: bytes) -> Optional[bytes]:
+    """Parse a JSON message payload in the C++ core and encode it with the
+    native binary-v2 codec (None when the type has no binary form) — the
+    cross-runtime byte-parity surface for tests/test_wire_codec.py."""
+    fn = lib().pbft_message_to_binary
+    fn.restype = ctypes.c_size_t
+    out = ctypes.create_string_buffer(len(payload) + 256)
+    n = fn(payload, len(payload), out, len(out))
+    if n == 0 or n > len(out):
+        return None
+    return out.raw[:n]
+
+
+def message_from_binary(payload: bytes) -> Optional[Tuple[bytes, bytes]]:
+    """Decode a binary-v2 payload in the C++ core: returns (canonical
+    JSON bytes, signable digest) or None on decode failure."""
+    fn = lib().pbft_message_from_binary
+    fn.restype = ctypes.c_size_t
+    out = ctypes.create_string_buffer(4 * len(payload) + 1024)
+    digest = ctypes.create_string_buffer(32)
+    n = fn(payload, len(payload), out, len(out), digest)
+    if n == 0 or n > len(out):
+        return None
+    return out.raw[:n], digest.raw
+
+
+def signable_from_payload(payload: bytes) -> Optional[bytes]:
+    """The C++ receive-side signable derivation (JSON sig-splice / binary
+    template, with the generic fallback) for a framed payload."""
+    fn = lib().pbft_signable_from_payload
+    fn.restype = ctypes.c_int
+    digest = ctypes.create_string_buffer(32)
+    if not fn(payload, len(payload), digest):
+        return None
+    return digest.raw

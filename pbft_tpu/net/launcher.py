@@ -61,6 +61,7 @@ class LocalCluster:
         secure: bool = False,
         verify_flush_us: int = 0,
         verify_flush_items: int = 0,
+        extra_env: Optional[List[Optional[dict]]] = None,
     ):
         self.trace_dir = trace_dir
         # Replica ids whose daemons corrupt every outgoing signature
@@ -91,6 +92,9 @@ class LocalCluster:
         self.metrics_every = metrics_every
         self.vc_timeout_ms = vc_timeout_ms
         self.impl = [impl] * self.config.n if isinstance(impl, str) else list(impl)
+        # Per-replica environment overrides (e.g. PBFT_WIRE_CODEC=json to
+        # force a JSON-only 1.0.0 peer in a mixed-codec interop test).
+        self.extra_env = extra_env or [None] * self.config.n
         self.procs: List[subprocess.Popen] = []
         self.tmpdir: Optional[tempfile.TemporaryDirectory] = None
         self._cmds: List[tuple] = []  # (cmd, env) per replica, for revive()
@@ -124,6 +128,9 @@ class LocalCluster:
                     # Keep a cpu-verifier replica from initializing any
                     # accelerator backend at import time.
                     env["JAX_PLATFORMS"] = "cpu"
+            if self.extra_env[i]:
+                env = dict(env if env is not None else os.environ)
+                env.update(self.extra_env[i])
             cmd += [
                 "--config",
                 str(cfg_path),

@@ -42,12 +42,51 @@ from __future__ import annotations
 
 import hashlib
 import hmac
+import os
 import secrets
 from typing import Optional, Tuple
 
+from ..consensus.messages import CODEC_BINARY2
 from ..crypto import ref
 
-PROTOCOL_VERSION = "pbft-tpu/1.0.0"
+# 1.1.0 adds the negotiated binary-v2 payload codec
+# (consensus/messages.py); 1.0.0 peers stay interoperable — the hello's
+# ver gates what a sender may offer, and the handshake transcript binds
+# to the initiator's advertised version so mixed-version secure
+# handshakes still agree on the signed bytes.
+PROTOCOL_VERSION = "pbft-tpu/1.1.0"
+PROTOCOL_VERSION_LEGACY = "pbft-tpu/1.0.0"
+_COMPATIBLE_VERSIONS = (PROTOCOL_VERSION, PROTOCOL_VERSION_LEGACY)
+
+
+def _wire_json_forced() -> bool:
+    return os.environ.get("PBFT_WIRE_CODEC") == "json"
+
+
+def wire_hello_version() -> str:
+    """The version this node advertises: 1.1.0 with the binary-codec
+    offer, or the legacy 1.0.0 JSON-only hello when PBFT_WIRE_CODEC=json
+    (the mixed-cluster escape hatch and the interop-test lever)."""
+    return PROTOCOL_VERSION_LEGACY if _wire_json_forced() else PROTOCOL_VERSION
+
+
+def wire_offer_binary() -> bool:
+    return not _wire_json_forced()
+
+
+def hello_offers_binary(obj: dict) -> bool:
+    """True when a peer's hello offers the binary-v2 codec (and this node
+    offers it too): the sender may then encode hot messages as binary."""
+    if not wire_offer_binary():
+        return False
+    codecs = obj.get("codecs")
+    return isinstance(codecs, list) and CODEC_BINARY2 in codecs
+
+
+def _attach_codecs(o: dict) -> dict:
+    if wire_offer_binary():
+        o["codecs"] = [CODEC_BINARY2]
+    return o
 _HS_CONTEXT = b"pbft-tpu-hs1|"
 _KDF_CONTEXT = b"pbft-tpu-k1|"
 TAG_LEN = 16
@@ -190,21 +229,29 @@ class SecureChannel:
         self._send_ctr = 0
         self._recv_ctr = 0
         self.established = False
+        # The transcript binds to the INITIATOR's advertised version
+        # (both sides know it after hello_i): initiator = the version it
+        # sends; responder = set from hello_i in on_hello.
+        self._hs_version = wire_hello_version()
 
     # -- handshake ----------------------------------------------------------
 
     def initiator_hello(self) -> dict:
-        return {
-            "type": "hello",
-            "ver": PROTOCOL_VERSION,
-            "node": self.my_id,
-            "eph": self.eph_pub.hex(),
-        }
+        return _attach_codecs(
+            {
+                "type": "hello",
+                "ver": wire_hello_version(),
+                "node": self.my_id,
+                "eph": self.eph_pub.hex(),
+            }
+        )
 
     @staticmethod
     def check_version(obj: dict) -> None:
+        # Compatible set, not exact match: 1.1.0 only ADDS the negotiated
+        # binary codec, so 1.0.0 peers interoperate (JSON frames both ways).
         ver = obj.get("ver")
-        if ver != PROTOCOL_VERSION:
+        if ver not in _COMPATIBLE_VERSIONS:
             raise HandshakeError(
                 f"protocol version mismatch: peer speaks {ver!r}, "
                 f"this node speaks {PROTOCOL_VERSION!r}"
@@ -213,7 +260,7 @@ class SecureChannel:
     def _transcript(self) -> bytes:
         eph_i = self.eph_pub if self.initiator else self._peer_eph
         eph_r = self._peer_eph if self.initiator else self.eph_pub
-        return transcript(PROTOCOL_VERSION, eph_i, eph_r)
+        return transcript(self._hs_version, eph_i, eph_r)
 
     def _finish(self) -> None:
         shared = dh_shared(self._eph_secret, self._peer_eph)
@@ -250,15 +297,20 @@ class SecureChannel:
                 "plaintext peer rejected: this cluster requires encrypted "
                 "links (hello carried no ephemeral key)"
             )
+        # check_version admitted the initiator's version into the
+        # compatible set; the transcript binds to it.
+        self._hs_version = obj["ver"]
         self._peer_eph = _hex_field(obj, "eph", 32)
         sig = ref.sign(self._seed, self._transcript() + b"|resp")
-        return {
-            "type": "hello",
-            "ver": PROTOCOL_VERSION,
-            "node": self.my_id,
-            "eph": self.eph_pub.hex(),
-            "sig": sig.hex(),
-        }
+        return _attach_codecs(
+            {
+                "type": "hello",
+                "ver": wire_hello_version(),
+                "node": self.my_id,
+                "eph": self.eph_pub.hex(),
+                "sig": sig.hex(),
+            }
+        )
 
     def on_hello_reply(self, obj: dict) -> dict:
         """Initiator: process hello_r, return auth_i; channel established."""
@@ -299,9 +351,13 @@ class SecureChannel:
 
 
 def reject_payload(reason: str) -> dict:
-    return {"type": "reject", "reason": reason, "ver": PROTOCOL_VERSION}
+    return {"type": "reject", "reason": reason, "ver": wire_hello_version()}
 
 
 def plain_hello(my_id: int) -> dict:
-    """The version-check-only hello sent on plaintext peer links."""
-    return {"type": "hello", "ver": PROTOCOL_VERSION, "node": my_id}
+    """The version-carrying (and codec-offering) hello sent on plaintext
+    peer links — both as the dialing side's first frame and as the
+    responder's hello-ack that lets the dialer negotiate binary-v2."""
+    return _attach_codecs(
+        {"type": "hello", "ver": wire_hello_version(), "node": my_id}
+    )

@@ -32,7 +32,10 @@ from ..consensus.messages import (
     ClientReply,
     ClientRequest,
     Message,
+    decode_payload,
     from_wire,
+    signable_from_payload,
+    to_binary,
     with_sig,
 )
 from ..consensus.replica import Broadcast, Replica, Reply, Send
@@ -42,6 +45,63 @@ from . import secure
 
 def _frame_bytes(payload: bytes) -> bytes:
     return len(payload).to_bytes(4, "big") + payload
+
+
+class _PeerLink:
+    """One dialed peer link: the stream writer, the secure channel (None
+    on plaintext links), and the negotiated payload codec. ``binary``
+    flips when the peer's hello (plaintext hello-ack or secure hello_r)
+    offers the binary-v2 codec; frames sent before that go as JSON —
+    receivers detect the codec per frame."""
+
+    __slots__ = ("writer", "chan", "binary")
+
+    def __init__(self, writer, chan=None, binary=False):
+        self.writer = writer
+        self.chan = chan
+        self.binary = binary
+
+
+class _EncodedOut:
+    """A message mid-fan-out: canonical JSON and binary-v2 encodings are
+    computed lazily, AT MOST ONCE each, however many peers the message
+    goes to (the serialize-once invariant). Encoding is synchronous, so
+    concurrent _send_to tasks sharing one instance cannot race. When the
+    owning server is set, each actual encode bumps its
+    ``broadcast_encodes`` counter — the invariant test compares that
+    against the broadcast count (encodes == broadcasts, never
+    broadcasts x peers)."""
+
+    __slots__ = ("msg", "_json", "_binary", "_binary_tried", "_server")
+
+    def __init__(self, msg: Message, server=None):
+        self.msg = msg
+        self._json: Optional[bytes] = None
+        self._binary: Optional[bytes] = None
+        self._binary_tried = False
+        self._server = server
+
+    def _count(self) -> None:
+        if self._server is not None:
+            self._server.broadcast_encodes += 1
+            if self._server.metrics_registry.enabled:
+                self._server.metrics_registry.counter(
+                    "pbft_broadcast_encodes_total"
+                ).inc()
+
+    def json_payload(self) -> bytes:
+        if self._json is None:
+            self._json = self.msg.canonical()
+            self._count()
+        return self._json
+
+    def binary_payload(self) -> Optional[bytes]:
+        if not self._binary_tried:
+            self._binary_tried = True
+            self._binary = to_binary(self.msg)
+            if self._binary is not None:
+                self._count()
+        return self._binary
 
 
 def _frame_obj(obj: dict) -> bytes:
@@ -130,16 +190,22 @@ class AsyncReplicaServer:
         # Byzantine signer trusts its own messages).
         self.byzantine = byzantine
         self._server: Optional[asyncio.Server] = None
-        # dest -> (writer, SecureChannel | None); guarded by a per-dest
-        # lock so one handshake runs per destination and sealed-frame
-        # counters never interleave.
-        self._peer_links: Dict[int, Tuple[asyncio.StreamWriter, Optional[secure.SecureChannel]]] = {}
+        # dest -> _PeerLink; guarded by a per-dest lock so one handshake
+        # runs per destination and sealed-frame counters never interleave.
+        self._peer_links: Dict[int, _PeerLink] = {}
         self._peer_locks: Dict[int, asyncio.Lock] = {}
         self._batch_wakeup = asyncio.Event()
         self._stopping = False
         self.listen_port = 0
         self.batches_run = 0
         self.frames_in = 0
+        # Serialize-once accounting (metrics() + the counter-based
+        # invariant test): encodes track broadcasts, never
+        # broadcasts x peers. Frame counters split by negotiated codec.
+        self.broadcasts = 0
+        self.broadcast_encodes = 0
+        self.codec_binary_frames = 0
+        self.codec_json_frames = 0
         # Reply-dial pacing (mirrors core/net.cc start_reply_dial): the
         # reply address is UNTRUSTED client input, so dials are
         # deadline-bounded, capped in flight, and serialized per address
@@ -193,8 +259,8 @@ class AsyncReplicaServer:
         if self._server:
             self._server.close()
             await self._server.wait_closed()
-        for w, _ in self._peer_links.values():
-            w.close()
+        for link in self._peer_links.values():
+            link.writer.close()
 
     # -- inbound ------------------------------------------------------------
 
@@ -306,6 +372,16 @@ class AsyncReplicaServer:
                                 reply = chan.on_hello(obj)
                                 writer.write(_frame_obj(reply))
                                 await writer.drain()
+                            else:
+                                # Plaintext hello-ack: advertise this
+                                # node's version + codec offer so the
+                                # dialing peer can negotiate binary-v2
+                                # (a 1.0.0 initiator parses and ignores
+                                # any non-reject frame).
+                                writer.write(
+                                    _frame_obj(secure.plain_hello(self.id))
+                                )
+                                await writer.drain()
                             continue
                     elif chan is not None:
                         if not isinstance(obj, dict) or obj.get("type") != "auth":
@@ -325,16 +401,26 @@ class AsyncReplicaServer:
                 except secure.HandshakeError:
                     return  # tampered/desynced stream: drop the connection
             try:
-                msg = from_wire(payload)
+                msg = decode_payload(payload)
             except (ValueError, KeyError, json.JSONDecodeError):
                 continue
-            self._ingest(msg)
+            self._ingest(msg, payload)
 
-    def _ingest(self, msg: Message) -> None:
+    def _ingest(self, msg: Message, payload: Optional[bytes] = None) -> None:
         self.frames_in += 1
         if self.metrics_registry.enabled:
             self.metrics_registry.counter("pbft_frames_in_total").inc()
-        actions = self.replica.receive(msg)
+        if payload is not None and not isinstance(msg, ClientRequest):
+            # Receive-side canonical reuse: derive the signable digest
+            # from the framed bytes (sig-splice for JSON; the binary path
+            # falls through to the fixed signable template) so the verify
+            # queue never re-serializes. The raw client gateway passes no
+            # payload — its input is not guaranteed canonical.
+            actions = self.replica.receive(
+                msg, signable_from_payload(payload, msg)
+            )
+        else:
+            actions = self.replica.receive(msg)
         if actions:
             self._emit(actions)
         self._batch_wakeup.set()
@@ -406,9 +492,15 @@ class AsyncReplicaServer:
         loop = asyncio.get_running_loop()
         for act in actions:
             if isinstance(act, Broadcast):
+                # Serialize-once fan-out: ONE canonical encode (and at
+                # most one binary-v2 encode, when any link negotiated it)
+                # per broadcast, shared by every destination task. The
+                # Byzantine corruption is applied once too.
+                self.broadcasts += 1
+                enc = _EncodedOut(self._corrupt_sig(act.msg), server=self)
                 for dest in range(self.config.n):
                     if dest != self.id:
-                        loop.create_task(self._send_to(dest, act.msg))
+                        loop.create_task(self._send_to(dest, enc))
             elif isinstance(act, Send):
                 if isinstance(act.msg, ClientRequest) and self.vc_timeout > 0:
                     self._waiting_requests[
@@ -417,16 +509,18 @@ class AsyncReplicaServer:
                 if act.dest == self.id:
                     self._ingest(act.msg)
                 else:
-                    loop.create_task(self._send_to(act.dest, act.msg))
+                    loop.create_task(
+                        self._send_to(
+                            act.dest, _EncodedOut(self._corrupt_sig(act.msg))
+                        )
+                    )
             elif isinstance(act, Reply):
                 self._waiting_requests.pop(
                     (act.msg.client, act.msg.timestamp), None
                 )
                 loop.create_task(self._dial_reply(act.client, act.msg))
 
-    async def _open_peer_link(
-        self, dest: int
-    ) -> Optional[Tuple[asyncio.StreamWriter, Optional[secure.SecureChannel]]]:
+    async def _open_peer_link(self, dest: int) -> Optional[_PeerLink]:
         """Dial a peer and run the link prologue: always a hello first
         frame (protocol version); in secure clusters the full initiator
         handshake (hello -> hello_r -> auth) before any protocol frame."""
@@ -454,13 +548,15 @@ class AsyncReplicaServer:
             return None  # peer down: PBFT tolerates f of these
         if not self.secure:
             writer.write(_frame_obj(secure.plain_hello(self.id)))
-            # A version-mismatched responder answers with a reject frame;
-            # watch for it so the failure is loud (the C++ initiator
-            # read-polls its dialed links for the same reason).
+            # A version-mismatched responder answers with a reject frame,
+            # and a 1.1.0 responder answers with its own hello (the codec
+            # offer); watch for both so rejects are loud and the link
+            # upgrades to binary-v2 the moment the offer arrives.
+            link = _PeerLink(writer)
             asyncio.get_running_loop().create_task(
-                self._watch_link(dest, reader, writer)
+                self._watch_link(dest, reader, link)
             )
-            return writer, None
+            return link
         chan = secure.SecureChannel(
             self.id,
             self._seed,
@@ -492,17 +588,22 @@ class AsyncReplicaServer:
         # Secure links need the watcher too: a responder-side reject or
         # close after the handshake must drop the cached link immediately,
         # not linger until the next write fails (silently losing one send).
+        # hello_r carried the responder's codec offer: binary-v2 from here
+        # on when both sides speak it.
+        link = _PeerLink(writer, chan, binary=secure.hello_offers_binary(reply))
         asyncio.get_running_loop().create_task(
-            self._watch_link(dest, reader, writer)
+            self._watch_link(dest, reader, link)
         )
-        return writer, chan
+        return link
 
-    async def _watch_link(self, dest: int, reader, writer) -> None:
-        """Watch a dialed link (plain or secure) for reject frames and
-        EOF. Dropping the cached link the moment the responder closes or
+    async def _watch_link(self, dest: int, reader, link: _PeerLink) -> None:
+        """Watch a dialed link (plain or secure) for reject frames, the
+        plaintext hello-ack (the responder's codec offer), and EOF.
+        Dropping the cached link the moment the responder closes or
         rejects means the next _send_to re-dials instead of writing into
         a dead socket's kernel buffer (which would silently lose the
         first post-failure send)."""
+        writer = link.writer
         try:
             while True:
                 raw = await _read_frame(reader, timeout=3600.0)
@@ -517,6 +618,8 @@ class AsyncReplicaServer:
                         flush=True,
                     )
                     break
+                if isinstance(obj, dict) and obj.get("type") == "hello":
+                    link.binary = secure.hello_offers_binary(obj)
         except (
             ConnectionError,
             OSError,
@@ -526,7 +629,7 @@ class AsyncReplicaServer:
         ):
             pass  # EOF / dead or hour-idle link: drop and re-dial on demand
         writer.close()
-        if (link := self._peer_links.get(dest)) and link[0] is writer:
+        if (cached := self._peer_links.get(dest)) and cached.writer is writer:
             self._peer_links.pop(dest, None)
 
     def _corrupt_sig(self, msg: Message) -> Message:
@@ -539,23 +642,37 @@ class AsyncReplicaServer:
             return msg
         return with_sig(msg, "f" * len(sig))
 
-    async def _send_to(self, dest: int, msg: Message) -> None:
-        msg = self._corrupt_sig(msg)
+    async def _send_to(self, dest: int, enc: _EncodedOut) -> None:
         lock = self._peer_locks.setdefault(dest, asyncio.Lock())
         async with lock:
             link = self._peer_links.get(dest)
-            if link is None or link[0].is_closing():
+            if link is None or link.writer.is_closing():
                 link = await self._open_peer_link(dest)
                 if link is None:
                     return
                 self._peer_links[dest] = link
-            writer, chan = link
-            payload = msg.canonical()
-            if chan is not None:
-                payload = chan.seal_frame(payload)
+            payload = enc.binary_payload() if link.binary else None
+            if payload is not None:
+                self.codec_binary_frames += 1
+                if self.metrics_registry.enabled:
+                    self.metrics_registry.counter(
+                        "pbft_codec_binary_frames_total"
+                    ).inc()
+            else:
+                payload = enc.json_payload()
+                self.codec_json_frames += 1
+                if self.metrics_registry.enabled:
+                    self.metrics_registry.counter(
+                        "pbft_codec_json_frames_total"
+                    ).inc()
+            if link.chan is not None:
+                # Per-peer sealing over the SHARED plaintext: the AEAD
+                # counter is per-link state, so only the seal (not the
+                # encode) runs per peer.
+                payload = link.chan.seal_frame(payload)
             try:
-                writer.write(_frame_bytes(payload))
-                await writer.drain()
+                link.writer.write(_frame_bytes(payload))
+                await link.writer.drain()
             except (ConnectionError, OSError):
                 self._peer_links.pop(dest, None)
 
@@ -660,6 +777,10 @@ class AsyncReplicaServer:
             "port": self.listen_port,
             "frames_in": self.frames_in,
             "verify_batches": self.batches_run,
+            "broadcasts": self.broadcasts,
+            "broadcast_encodes": self.broadcast_encodes,
+            "codec_binary_frames": self.codec_binary_frames,
+            "codec_json_frames": self.codec_json_frames,
             "executed_upto": self.replica.executed_upto,
             "low_mark": self.replica.low_mark,
             "view": self.replica.view,

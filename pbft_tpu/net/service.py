@@ -32,13 +32,19 @@ Item = Tuple[bytes, bytes, bytes]
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
-    buf = b""
-    while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
-        if not chunk:
+    # Preallocated buffer + recv_into: the n*128-byte blob read is on the
+    # coalesced-window hot path, and the old `bytes += chunk` accumulation
+    # re-copied the whole prefix per chunk (quadratic across a large
+    # window split into MTU-sized reads).
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        r = sock.recv_into(view[got:], n - got)
+        if not r:
             raise ConnectionError("peer closed mid-message")
-        buf += chunk
-    return buf
+        got += r
+    return bytes(buf)
 
 
 def jax_backend(items: List[Item]) -> List[bool]:

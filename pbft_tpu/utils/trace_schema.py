@@ -85,6 +85,14 @@ METRIC_SCHEMAS = {
     "pbft_verify_pool_queue_depth": ("gauge", {"net.cc"}),
     "pbft_verify_pool_utilization": ("gauge", {"net.cc"}),
     "pbft_verify_pool_window_size": ("histogram", {"net.cc"}),
+    # Wire-codec surface (ISSUE 3): outbound frames per payload codec,
+    # plus the serialize-once invariant counter — encodes are counted per
+    # BROADCAST (lazy, at most once per codec), never per peer, so in a
+    # single-codec cluster pbft_broadcast_encodes_total tracks the
+    # broadcast count instead of broadcasts x peers.
+    "pbft_codec_binary_frames_total": ("counter", {"server.py", "net.cc"}),
+    "pbft_codec_json_frames_total": ("counter", {"server.py", "net.cc"}),
+    "pbft_broadcast_encodes_total": ("counter", {"server.py", "net.cc"}),
     "pbft_verify_batch_size": ("histogram", {"server.py", "service.py", "net.cc"}),
     "pbft_verify_seconds": ("histogram", {"server.py", "service.py", "net.cc"}),
     "pbft_phase_pre_prepare_seconds": ("histogram", {"server.py", "net.cc"}),

@@ -213,6 +213,40 @@ def test_pool_lifecycle_stress_fast():
     assert 0.0 <= stats["utilization"] <= 1.0 + 1e-9
 
 
+def test_pubkey_cache_warm_cold_parity():
+    """The per-key decompressed-point cache (ISSUE 3 satellite) is pure
+    memoization: a replica-shaped batch (a tiny stable key set, repeated)
+    must produce identical verdicts cold (empty cache), warm (every key
+    cached), and with the cache disabled outright — including corrupted
+    signatures, a non-canonical pubkey, and at every pool width."""
+    n = WINDOW + 40  # two windows, second ragged
+    # 4 signer identities repeated across the batch — the replica shape
+    # the cache exists for.
+    items = [_signed(i % 4, msg=bytes([i % 256, 0x31]) * 16) for i in range(n)]
+    bad = {3, WINDOW - 1, WINDOW + 5}
+    for i in bad:
+        items[i] = _corrupt(items[i])
+    # A non-canonical pubkey encoding (y >= p): decompression fails, the
+    # failure itself must cache without flipping any verdict.
+    items[7] = (b"\xff" * 32, items[7][1], items[7][2])
+    bad.add(7)
+    want = [i not in bad for i in range(n)]
+    try:
+        for t in THREAD_COUNTS:
+            native.set_verify_threads(t)
+            native.pubkey_cache_clear()
+            cold = native.verify_batch(items)
+            warm = native.verify_batch(items)  # every key now cached
+            native.pubkey_cache_disable(True)
+            nocache = native.verify_batch(items)
+            native.pubkey_cache_disable(False)
+            assert cold == want, f"threads={t}"
+            assert warm == cold, f"threads={t}"
+            assert nocache == cold, f"threads={t}"
+    finally:
+        native.pubkey_cache_disable(False)
+
+
 def test_bench_native_arm_reports_threads(tmp_path):
     """The bench's native arm must emit threads + single-thread vs pooled
     rates (acceptance criterion surface) — run it in-process-shaped via a
