@@ -427,6 +427,36 @@ def main() -> None:
         if not _native_fallback(target_secs, None, backend="cpu-native"):
             _fail("native", "native core unavailable")
         return
+    if os.environ.get("PBFT_BENCH_CONSENSUS"):
+        # Consensus-protocol entry (ISSUE 4): drive the f=1 firehose
+        # through real pbftd daemons and report requests/sec alongside
+        # rounds/sec plus the measured mean batch occupancy —
+        # PBFT_BATCH_MAX_ITEMS / PBFT_BATCH_FLUSH_US select the batching
+        # knobs (1/0 = the pre-batching protocol).
+        from pbft_tpu.bench.harness import run_native_config
+
+        res = run_native_config(
+            1,  # firehose f=1
+            requests=int(os.environ.get("PBFT_BENCH_REQUESTS", "960")),
+            pipeline=int(os.environ.get("PBFT_BENCH_PIPELINE", "64")),
+            batch_max_items=int(os.environ.get("PBFT_BATCH_MAX_ITEMS", "1")),
+            batch_flush_us=int(os.environ.get("PBFT_BATCH_FLUSH_US", "0")),
+        )
+        print(
+            json.dumps(
+                {
+                    "metric": "pbft_requests_per_sec",
+                    "value": res.requests_per_sec,
+                    "unit": "requests/sec",
+                    "rounds_per_sec": res.rounds_per_sec,
+                    "mean_batch": res.mean_batch,
+                    "batch_max_items": res.batch_max_items,
+                    "batch_flush_us": res.batch_flush_us,
+                    "backend": "consensus-native",
+                }
+            )
+        )
+        return
     if os.environ.get("PBFT_BENCH_CPU") or os.environ.get("JAX_PLATFORMS") == "cpu":
         os.environ["JAX_PLATFORMS"] = "cpu"
         _force_cpu()

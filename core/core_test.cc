@@ -173,6 +173,63 @@ void test_four_replica_commit() {
   for (auto& r : c.replicas) CHECK(r.executed_upto() == 1);
 }
 
+void test_batched_round_native() {
+  // ISSUE 4: one three-phase instance per request batch. Three requests
+  // fill a batch_max_items=3 batch -> ONE sequence number, one reply per
+  // request on every replica, and the digest is the batched definition.
+  std::vector<std::vector<uint8_t>> seeds;
+  auto cfg = test_config(&seeds);
+  cfg.batch_max_items = 3;
+  MiniCluster c(cfg, seeds);
+  for (int i = 0; i < 2; ++i) {
+    pbft::ClientRequest req;
+    req.operation = "batched-" + std::to_string(i);
+    req.timestamp = 1;
+    req.client = "127.0.0.1:990" + std::to_string(i);
+    auto acts = c.replicas[0].on_client_request(req);
+    CHECK(acts.broadcasts.empty());  // batch still open
+    c.emit(0, std::move(acts));
+  }
+  CHECK(c.replicas[0].open_batch_size() == 2);
+  // A retransmission of an OPEN-batch request claims no second slot.
+  {
+    pbft::ClientRequest dup;
+    dup.operation = "batched-0";
+    dup.timestamp = 1;
+    dup.client = "127.0.0.1:9900";
+    c.emit(0, c.replicas[0].on_client_request(dup));
+    CHECK(c.replicas[0].open_batch_size() == 2);
+  }
+  pbft::ClientRequest req;
+  req.operation = "batched-2";
+  req.timestamp = 1;
+  req.client = "127.0.0.1:9902";
+  auto acts = c.replicas[0].on_client_request(req);  // seals at 3
+  CHECK(acts.broadcasts.size() == 1);
+  auto* pp = std::get_if<pbft::PrePrepare>(&acts.broadcasts[0].msg);
+  CHECK(pp && pp->requests.size() == 3);
+  CHECK(pp->digest == pbft::batch_digest_hex(pp->requests));
+  c.emit(0, std::move(acts));
+  c.run();
+  CHECK(c.replies.size() == 4 * 3);  // one reply per request per replica
+  for (auto& r : c.replicas) {
+    CHECK(r.executed_upto() == 1);  // ONE instance for the whole batch
+    CHECK(r.counters["rounds_executed"] == 1);
+    CHECK(r.counters["executed"] == 3);
+  }
+  // flush_open_batch seals a partial batch (the runtime timer path).
+  pbft::ClientRequest solo;
+  solo.operation = "partial";
+  solo.timestamp = 1;
+  solo.client = "127.0.0.1:9909";
+  c.emit(0, c.replicas[0].on_client_request(solo));
+  CHECK(c.replicas[0].open_batch_size() == 1);
+  c.emit(0, c.replicas[0].flush_open_batch());
+  CHECK(c.replicas[0].open_batch_size() == 0);
+  c.run();
+  for (auto& r : c.replicas) CHECK(r.executed_upto() == 2);
+}
+
 void test_view_change_native() {
   std::vector<std::vector<uint8_t>> seeds;
   auto cfg = test_config(&seeds);
@@ -583,6 +640,7 @@ int main() {
   test_canonical_json();
   test_secure_channel_native();
   test_four_replica_commit();
+  test_batched_round_native();
   test_view_change_native();
   test_stable_digest_majority_native();
   test_state_transfer_native();

@@ -61,11 +61,35 @@ Json ClientReply::to_json() const {
   return Json(std::move(o));
 }
 
+std::string batch_digest_hex(const std::vector<ClientRequest>& requests) {
+  if (requests.size() == 1) return requests[0].digest_hex();
+  std::string cat;
+  cat.reserve(32 * requests.size());
+  for (const ClientRequest& r : requests) {
+    uint8_t raw[32];
+    if (!from_hex(r.digest_hex(), raw, 32)) return std::string();
+    cat.append((const char*)raw, 32);
+  }
+  uint8_t d[32];
+  blake2b_256(d, (const uint8_t*)cat.data(), cat.size());
+  return to_hex(d, 32);
+}
+
 Json PrePrepare::to_json() const {
   JsonObject o;
   o.emplace("digest", digest);
   o.emplace("replica", replica);
-  o.emplace("request", request.to_json(/*with_type=*/false));
+  if (requests.size() == 1) {
+    // Legacy singular member: batch=1 stays byte-identical to
+    // pre-batching peers.
+    o.emplace("request", requests[0].to_json(/*with_type=*/false));
+  } else {
+    JsonArray arr;
+    for (const ClientRequest& r : requests) {
+      arr.push_back(r.to_json(/*with_type=*/false));
+    }
+    o.emplace("requests", Json(std::move(arr)));
+  }
   o.emplace("seq", seq);
   o.emplace("sig", sig);
   o.emplace("type", "pre-prepare");
@@ -201,12 +225,25 @@ bool signable_fast(const Message& m, std::string* b) {
     *b += "{\"digest\":";
     append_jstr(b, pp->digest);
     *b += ",\"replica\":" + std::to_string(pp->replica);
-    *b += ",\"request\":{\"client\":";
-    append_jstr(b, pp->request.client);
-    *b += ",\"operation\":";
-    append_jstr(b, pp->request.operation);
-    *b += ",\"timestamp\":" + std::to_string(pp->request.timestamp);
-    *b += "},\"seq\":" + std::to_string(pp->seq);
+    auto req_body = [b](const ClientRequest& r) {
+      *b += "{\"client\":";
+      append_jstr(b, r.client);
+      *b += ",\"operation\":";
+      append_jstr(b, r.operation);
+      *b += ",\"timestamp\":" + std::to_string(r.timestamp) + "}";
+    };
+    if (pp->requests.size() == 1) {
+      *b += ",\"request\":";
+      req_body(pp->requests[0]);
+    } else {
+      *b += ",\"requests\":[";
+      for (size_t i = 0; i < pp->requests.size(); ++i) {
+        if (i) *b += ",";
+        req_body(pp->requests[i]);
+      }
+      *b += "]";
+    }
+    *b += ",\"seq\":" + std::to_string(pp->seq);
     *b += ",\"type\":\"pre-prepare\",\"view\":" + std::to_string(pp->view) +
           "}";
     return true;
@@ -347,12 +384,27 @@ std::optional<Message> message_from_json(const Json& j) {
   }
   if (type == "pre-prepare") {
     PrePrepare r;
-    const Json* req = j.find("request");
-    if (!req || !req->is_object() || !parse_request_fields(*req, &r.request) ||
-        !get_int(j, "view", &r.view) || !get_int(j, "seq", &r.seq) ||
+    if (!get_int(j, "view", &r.view) || !get_int(j, "seq", &r.seq) ||
         !get_str(j, "digest", &r.digest) || !get_int(j, "replica", &r.replica) ||
         !get_str(j, "sig", &r.sig))
       return std::nullopt;
+    const Json* req = j.find("request");
+    const Json* reqs = j.find("requests");
+    if (req && req->is_object() && !reqs) {
+      ClientRequest one;
+      if (!parse_request_fields(*req, &one)) return std::nullopt;
+      r.requests.push_back(std::move(one));
+    } else if (reqs && reqs->is_array() && !req) {
+      if (reqs->as_array().size() == 1) return std::nullopt;  // must be 0x02 form
+      for (const Json& rd : reqs->as_array()) {
+        ClientRequest one;
+        if (!rd.is_object() || !parse_request_fields(rd, &one))
+          return std::nullopt;
+        r.requests.push_back(std::move(one));
+      }
+    } else {
+      return std::nullopt;
+    }
     return Message(std::move(r));
   }
   if (type == "prepare" || type == "commit") {
@@ -422,7 +474,12 @@ enum : uint8_t {
   kBinPrepare = 0x03,
   kBinCommit = 0x04,
   kBinCheckpoint = 0x05,
+  // Batched pre-prepare (ISSUE 4): 0x02 header + u32 count + requests.
+  // Batches of one MUST use 0x02 (one canonical form per message).
+  kBinPrePrepareBatch = 0x06,
 };
+
+constexpr uint32_t kBinMaxBatch = 1u << 16;
 
 void put_i64(std::string* o, int64_t v) {
   uint64_t u = (uint64_t)v;
@@ -495,15 +552,23 @@ bool message_to_binary(const Message& m, std::string* out) {
     put_i64(&b, r->timestamp);
     put_str(&b, r->client);
   } else if (auto* pp = std::get_if<PrePrepare>(&m)) {
-    b.push_back((char)kBinPrePrepare);
+    const bool single = pp->requests.size() == 1;
+    if (!single && pp->requests.size() > kBinMaxBatch) return false;
+    b.push_back((char)(single ? kBinPrePrepare : kBinPrePrepareBatch));
     put_i64(&b, pp->view);
     put_i64(&b, pp->seq);
     if (!put_hex(&b, pp->digest, 32)) return false;
     put_i64(&b, pp->replica);
     if (!put_hex(&b, pp->sig, 64)) return false;
-    put_str(&b, pp->request.operation);
-    put_i64(&b, pp->request.timestamp);
-    put_str(&b, pp->request.client);
+    if (!single) {
+      uint32_t n = (uint32_t)pp->requests.size();
+      for (int i = 3; i >= 0; --i) b.push_back((char)(n >> (8 * i)));
+    }
+    for (const ClientRequest& r : pp->requests) {
+      put_str(&b, r.operation);
+      put_i64(&b, r.timestamp);
+      put_str(&b, r.client);
+    }
   } else if (auto* p = std::get_if<Prepare>(&m)) {
     b.push_back((char)kBinPrepare);
     put_i64(&b, p->view);
@@ -546,16 +611,30 @@ std::optional<Message> message_from_binary(const std::string& payload) {
       out = std::move(m);
       break;
     }
-    case kBinPrePrepare: {
+    case kBinPrePrepare:
+    case kBinPrePrepareBatch: {
       PrePrepare m;
       m.view = r.i64();
       m.seq = r.i64();
       m.digest = r.hex(32);
       m.replica = r.i64();
       m.sig = r.hex(64);
-      m.request.operation = r.str();
-      m.request.timestamp = r.i64();
-      m.request.client = r.str();
+      uint32_t count = 1;
+      if ((uint8_t)payload[1] == kBinPrePrepareBatch) {
+        count = 0;
+        if (r.need(4)) {
+          for (int i = 0; i < 4; ++i) count = (count << 8) | r.p[r.off++];
+        }
+        // count==1 must encode as 0x02 (one canonical form per message).
+        if (count == 1 || count > kBinMaxBatch) r.ok = false;
+      }
+      for (uint32_t i = 0; r.ok && i < count; ++i) {
+        ClientRequest req;
+        req.operation = r.str();
+        req.timestamp = r.i64();
+        req.client = r.str();
+        if (r.ok) m.requests.push_back(std::move(req));
+      }
       out = std::move(m);
       break;
     }

@@ -12,6 +12,7 @@
 #include <optional>
 #include <string>
 #include <variant>
+#include <vector>
 
 #include "json.h"
 
@@ -52,15 +53,27 @@ struct ClientReply {
   Json to_json() const;
 };
 
+// The pre-prepare content digest over an ordered request batch: a batch
+// of exactly one keeps the legacy definition (that request's digest) so
+// batch=1 stays byte-identical to pre-batching peers; any other size
+// (including the empty new-view gap filler) is Blake2b-256 over the
+// concatenated per-request digests. Mirrors messages.py batch_digest.
+std::string batch_digest_hex(const std::vector<ClientRequest>& requests);
+
 struct PrePrepare {
   int64_t view = 0;
   int64_t seq = 0;
   std::string digest;
-  ClientRequest request;
+  // The ordered request BATCH agreed under this sequence number
+  // (ISSUE 4). Size one encodes with the legacy singular `request`
+  // member (canonical JSON and binary alike); other sizes use the
+  // `requests` list / the 0x06 binary layout.
+  std::vector<ClientRequest> requests;
   int64_t replica = 0;
   std::string sig;  // hex
 
   Json to_json() const;
+  std::string batch_digest() const { return batch_digest_hex(requests); }
 };
 
 struct Prepare {
@@ -170,6 +183,11 @@ std::optional<Message> message_from_json(const Json& j);
 //   0x03 prepare:        view:i64 | seq:i64 | digest | replica:i64 | sig
 //   0x04 commit:         view:i64 | seq:i64 | digest | replica:i64 | sig
 //   0x05 checkpoint:     seq:i64 | digest | replica:i64 | sig
+//   0x06 pre-prepare (batched, ISSUE 4): same header as 0x02, then
+//                        count:u32 | count x (operation:str |
+//                        timestamp:i64 | client:str). Batches of exactly
+//                        one MUST encode as 0x02 (one canonical form per
+//                        message); decoders reject count==1.
 //
 // Signatures still cover the canonical-JSON signable digest, so one signed
 // message re-encodes for mixed-codec fan-out without re-signing.

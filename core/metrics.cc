@@ -26,6 +26,9 @@ const char* kCounterNames[] = {
     // peer — tests compare it against the broadcast count).
     "pbft_codec_binary_frames_total", "pbft_codec_json_frames_total",
     "pbft_broadcast_encodes_total",
+    // Batching surface (ISSUE 4): requests executed vs three-phase
+    // instances executed — their ratio is the batch amplification.
+    "pbft_requests_executed_total", "pbft_consensus_rounds_total",
 };
 const char* kGaugeNames[] = {
     "pbft_verify_queue_depth",
@@ -38,6 +41,7 @@ const char* kGaugeNames[] = {
 const std::pair<const char*, bool> kHistogramNames[] = {
     {"pbft_verify_batch_size", true},
     {"pbft_verify_pool_window_size", true},
+    {"pbft_batch_size", true},
     {"pbft_verify_seconds", false},
     {"pbft_phase_pre_prepare_seconds", false},
     {"pbft_phase_prepare_seconds", false},

@@ -87,6 +87,10 @@ ReplicaServer::ReplicaServer(ClusterConfig cfg, int64_t id,
   // neither metrics nor tracing is active (the Tracer discipline).
   replica_->phase_hook = [this](const char* phase, int64_t view,
                                 int64_t seq) { on_phase(phase, view, seq); };
+  // Batch occupancy at every pre-prepare accept (ISSUE 4).
+  replica_->batch_hook = [this](int64_t n) {
+    metrics_.observe("pbft_batch_size", (double)n);
+  };
 }
 
 ReplicaServer::~ReplicaServer() {
@@ -168,6 +172,16 @@ void ReplicaServer::poll_once(int timeout_ms) {
                    .count();
     timeout_ms = std::min<int64_t>(timeout_ms, std::max<int64_t>(rem, 0) + 1);
   }
+  if (batch_window_open_) {
+    // A partial request batch is waiting: the batch_flush_us deadline is
+    // a latency promise too — don't sleep past it.
+    auto deadline =
+        batch_window_start_ + std::chrono::microseconds(cfg_.batch_flush_us);
+    auto rem = std::chrono::duration_cast<std::chrono::milliseconds>(
+                   deadline - std::chrono::steady_clock::now())
+                   .count();
+    timeout_ms = std::min<int64_t>(timeout_ms, std::max<int64_t>(rem, 0) + 1);
+  }
   std::vector<pollfd> pfds;
   pfds.push_back({listen_fd_, POLLIN, 0});
   std::vector<Conn*> order;
@@ -236,6 +250,10 @@ void ReplicaServer::poll_once(int timeout_ms) {
     serve_metrics_ready();
   }
   check_verify_deadline(std::chrono::steady_clock::now());
+  // Seal a partial request batch once it has waited its flush window
+  // (ISSUE 4) — BEFORE the verify batch, so the resulting pre-prepare's
+  // self-delivered protocol messages ride this pass's verifier launch.
+  check_batch_flush(std::chrono::steady_clock::now());
   // The batching window: everything that arrived this iteration verifies
   // as one batch (one XLA launch on the TPU backend). With an async
   // verifier this immediately dispatches the window that accumulated
@@ -716,6 +734,31 @@ void ReplicaServer::check_verify_deadline(
   }
 }
 
+void ReplicaServer::check_batch_flush(
+    std::chrono::steady_clock::time_point now) {
+  if (replica_->open_batch_size() == 0) {
+    batch_window_open_ = false;
+    return;
+  }
+  if (!batch_window_open_) {
+    batch_window_open_ = true;
+    batch_window_start_ = now;
+  }
+  if (cfg_.batch_flush_us > 0 &&
+      now - batch_window_start_ <
+          std::chrono::microseconds(cfg_.batch_flush_us)) {
+    return;  // keep accumulating: more client requests may arrive
+  }
+  batch_window_open_ = false;
+  emit(replica_->flush_open_batch());
+  // A seal refused by a closed watermark window leaves the batch open;
+  // re-arm so the next tick retries instead of spinning the deadline.
+  if (replica_->open_batch_size() > 0) {
+    batch_window_open_ = true;
+    batch_window_start_ = now;
+  }
+}
+
 void ReplicaServer::run_verify_batch() {
   if (verify_inflight_) return;  // accumulate; finish_verify_async delivers
   size_t pending = replica_->pending_count();
@@ -879,6 +922,24 @@ void ReplicaServer::emit(Actions&& actions) {
   for (auto& r : actions.replies) {
     waiting_requests_.erase({r.msg.client, r.msg.timestamp});
     dial_reply(r.client, r.msg);
+  }
+  observe_execution_metrics();
+}
+
+void ReplicaServer::observe_execution_metrics() {
+  if (!metrics_.enabled) return;
+  // Deltas of the replica's own counters: "executed" counts per REQUEST,
+  // "rounds_executed" per sequence number — the two together are the
+  // batching amplification factor (requests per three-phase instance).
+  const int64_t executed = replica_->counters["executed"];
+  const int64_t rounds = replica_->counters["rounds_executed"];
+  if (executed > seen_executed_) {
+    metrics_.inc("pbft_requests_executed_total", executed - seen_executed_);
+    seen_executed_ = executed;
+  }
+  if (rounds > seen_rounds_) {
+    metrics_.inc("pbft_consensus_rounds_total", rounds - seen_rounds_);
+    seen_rounds_ = rounds;
   }
 }
 

@@ -206,6 +206,14 @@ class ReplicaServer {
   int peer_fd(int64_t dest);  // cached outbound connection (lazy dial)
 
   void check_progress_timer();
+  // Seal the primary's partial batch once it has waited batch_flush_us
+  // (ClusterConfig::batch_flush_us; 0 = seal on the next pass). poll_once
+  // clamps its timeout to the flush deadline, like the verify window.
+  void check_batch_flush(std::chrono::steady_clock::time_point now);
+  // Batching counters (pbft_requests_executed_total /
+  // pbft_consensus_rounds_total): recorded as deltas of the replica's
+  // executed / rounds_executed counters after every emit.
+  void observe_execution_metrics();
 
   ClusterConfig cfg_;
   int64_t id_;
@@ -281,6 +289,14 @@ class ReplicaServer {
   // promised latency bound.
   bool verify_window_open_ = false;
   std::chrono::steady_clock::time_point verify_window_start_{};
+  // Open request-batch window on the primary (ISSUE 4): opens when the
+  // first request joins the open batch, seals at batch_max_items (inside
+  // the replica) or at the batch_flush_us deadline (here).
+  bool batch_window_open_ = false;
+  std::chrono::steady_clock::time_point batch_window_start_{};
+  // Last-seen replica counters, for the executed/rounds metric deltas.
+  int64_t seen_executed_ = 0;
+  int64_t seen_rounds_ = 0;
   // Async verify launch in flight (RemoteVerifier): the event loop keeps
   // draining peers while the service runs the launch — the next window
   // accumulates during the round-trip instead of the loop stalling on it.

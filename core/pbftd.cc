@@ -7,7 +7,7 @@
 // Usage:
 //   pbftd --config network.json --id 0 --seed <64-hex>
 //         [--verifier cpu|host:port|/unix/path] [--verify-threads N]
-//         [--metrics-every 5]
+//         [--batch-max-items N] [--batch-flush-us US] [--metrics-every 5]
 //
 // The replica listens on its configured port for both framed peer traffic
 // and raw-JSON client connections (sniffed), verifies signature batches via
@@ -40,6 +40,8 @@ int main(int argc, char** argv) {
   int vc_timeout_ms = 0;
   int verify_deadline_ms = -1;
   int verify_threads = 0;  // 0 = hardware_concurrency (the pool default)
+  int64_t batch_max_items = -1;  // -1 = keep network.json's value
+  int64_t batch_flush_us = -1;
   bool byzantine = false;
   for (int i = 1; i < argc; ++i) {
     std::string a = argv[i];
@@ -53,6 +55,8 @@ int main(int argc, char** argv) {
     else if (a == "--vc-timeout-ms") vc_timeout_ms = std::atoi(next());
     else if (a == "--verify-deadline-ms") verify_deadline_ms = std::atoi(next());
     else if (a == "--verify-threads") verify_threads = std::atoi(next());
+    else if (a == "--batch-max-items") batch_max_items = std::atoll(next());
+    else if (a == "--batch-flush-us") batch_flush_us = std::atoll(next());
     else if (a == "--discovery") discovery = next();
     else if (a == "--trace") trace_path = next();
     else if (a == "--byzantine") byzantine = true;
@@ -85,6 +89,11 @@ int main(int argc, char** argv) {
     std::fprintf(stderr, "bad config or id out of range\n");
     return 1;
   }
+  // --batch-max-items / --batch-flush-us override network.json (ISSUE 4):
+  // how many requests the primary folds into one three-phase instance,
+  // and how long a partial batch may wait for more.
+  if (batch_max_items >= 1) cfg->batch_max_items = batch_max_items;
+  if (batch_flush_us >= 0) cfg->batch_flush_us = batch_flush_us;
   uint8_t seed[32];
   if (!pbft::from_hex(seed_hex, seed, 32)) {
     std::fprintf(stderr, "bad --seed hex\n");

@@ -26,6 +26,10 @@ std::optional<ClusterConfig> ClusterConfig::from_json_text(
     cfg.verify_flush_us = v->as_int();
   if (const Json* v = j->find("verify_flush_items"))
     cfg.verify_flush_items = v->as_int();
+  if (const Json* v = j->find("batch_max_items"))
+    cfg.batch_max_items = v->as_int();
+  if (const Json* v = j->find("batch_flush_us"))
+    cfg.batch_flush_us = v->as_int();
   if (const Json* v = j->find("verifier"); v && v->is_string())
     cfg.verifier = v->as_string();
   if (const Json* v = j->find("secure")) cfg.secure = v->as_bool();
@@ -56,7 +60,8 @@ Replica::Replica(ClusterConfig config, int64_t replica_id,
   for (const char* name :
        {"sig_verified", "sig_rejected", "pre_prepares_accepted",
         "prepares_accepted", "commits_accepted", "executed",
-        "duplicate_requests", "checkpoints_stable", "state_transfers"}) {
+        "rounds_executed", "duplicate_requests", "checkpoints_stable",
+        "state_transfers"}) {
     counters[name] = 0;
   }
 }
@@ -86,16 +91,41 @@ Actions Replica::on_client_request(const ClientRequest& req) {
     }
     return out;
   }
-  if (seq_counter_ + 1 > high_mark()) return out;  // window closed
+  // Duplicate suppression must also see the OPEN batch: a retransmission
+  // of a request still waiting unsealed must not burn a second slot.
+  auto pending = open_batch_ts_.find(req.client);
+  if (pending != open_batch_ts_.end() && req.timestamp <= pending->second) {
+    counters["duplicate_requests"] += 1;
+    return out;
+  }
+  open_batch_.push_back(req);
+  open_batch_ts_[req.client] = req.timestamp;
+  if ((int64_t)open_batch_.size() >= std::max<int64_t>(1, config_.batch_max_items)) {
+    return seal_batch();
+  }
+  return out;  // the runtime's batch_flush_us timer seals partials
+}
+
+Actions Replica::flush_open_batch() {
+  if (open_batch_.empty()) return {};
+  return seal_batch();
+}
+
+Actions Replica::seal_batch() {
+  if (seq_counter_ + 1 > high_mark()) return {};  // window closed: stay open
+  std::vector<ClientRequest> batch;
+  batch.swap(open_batch_);
+  open_batch_ts_.clear();
   seq_counter_ += 1;
   if (phase_hook) phase_hook("request", view_, seq_counter_);
   PrePrepare pp;
   pp.view = view_;
   pp.seq = seq_counter_;
-  pp.digest = req.digest_hex();
-  pp.request = req;
+  pp.requests = std::move(batch);
+  pp.digest = pp.batch_digest();
   pp.replica = id_;
   pp = sign(pp);
+  Actions out;
   out.broadcasts.push_back({Message(pp)});
   out.merge(accept_pre_prepare(pp));
   return out;
@@ -141,15 +171,6 @@ const std::string* sig_of(const Message& m) {
   if (auto* sr = std::get_if<StateRequest>(&m)) return &sr->sig;
   if (auto* sp = std::get_if<StateResponse>(&m)) return &sp->sig;
   return nullptr;
-}
-ClientRequest null_request() {
-  // Sequence-gap filler in a new view (PBFT §4.4): goes through the
-  // protocol like any request; its execution is a no-op.
-  ClientRequest r;
-  r.operation = "<null>";
-  r.timestamp = 0;
-  r.client = "<null>";
-  return r;
 }
 }  // namespace
 
@@ -212,7 +233,7 @@ Actions Replica::dispatch(const Message& msg) {
 Actions Replica::on_pre_prepare(const PrePrepare& pp) {
   if (in_view_change_) return {};  // §4.4: only cp/vc/nv accepted
   if (pp.view != view_ || pp.replica != primary()) return {};
-  if (pp.request.digest_hex() != pp.digest) return {};
+  if (pp.batch_digest() != pp.digest) return {};
   if (!in_window(pp.seq)) return {};
   if (pre_prepares_.count({pp.view, pp.seq})) return {};
   return accept_pre_prepare(pp);
@@ -223,6 +244,7 @@ Actions Replica::accept_pre_prepare(const PrePrepare& pp) {
   pre_prepares_.emplace(key, pp);
   counters["pre_prepares_accepted"] += 1;
   if (phase_hook) phase_hook("pre_prepare", pp.view, pp.seq);
+  if (batch_hook) batch_hook((int64_t)pp.requests.size());
   // The primary's pre-prepare stands in for its prepare (PBFT §4.2): only
   // backups multicast PREPARE, and prepared() wants 2f *backup* prepares,
   // giving 2f+1 distinct replicas per certificate.
@@ -333,46 +355,56 @@ Actions Replica::drain_executions() {
       if (phase_hook) phase_hook("executed", view, seq);
       continue;
     }
-    const ClientRequest& req = ppit->second.request;
+    const std::vector<ClientRequest>& batch = ppit->second.requests;
     executed_upto_ = seq;
+    counters["rounds_executed"] += 1;
     if (phase_hook) phase_hook("executed", view, seq);
-    if (req.client == "<null>") {
-      // Null request (view-change gap filler): no-op execution, no reply,
-      // but the sequence and state digest chain still advance.
+    auto null_fold = [&]() {
+      // No-op execution (null request / empty batch): no reply, but the
+      // sequence and state digest chain still advance — the SAME fold
+      // for both encodings, so the gap-filler forms cannot diverge.
       std::vector<uint8_t> buf(state_digest_, state_digest_ + 32);
       static const char* kNull = "<null>";
       buf.insert(buf.end(), kNull, kNull + 6);
       for (int i = 7; i >= 0; --i) buf.push_back((uint8_t)(seq >> (8 * i)));
       blake2b_256(state_digest_, buf.data(), buf.size());
-    } else {
+    };
+    if (batch.empty()) null_fold();  // batched new-view gap filler
+    for (const ClientRequest& req : batch) {
+      if (req.client == "<null>") {
+        // Legacy null request (a 1.1.0 peer's gap filler in a batch of 1).
+        null_fold();
+        continue;
+      }
       auto it = last_timestamp_.find(req.client);
       if (it != last_timestamp_.end() && req.timestamp <= it->second) {
+        // exactly-once, enforced per batch item in batch order
         counters["duplicate_requests"] += 1;
-      } else {
-        // Execution: the reference's app is a no-op returning "awesome!"
-        // (reference src/message.rs:70); kept as the built-in default —
-        // a stateful app overrides via the app_execute hook.
-        std::string result =
-            app_execute ? app_execute(req.operation, seq) : "awesome!";
-        counters["executed"] += 1;
-        {
-          std::vector<uint8_t> buf(state_digest_, state_digest_ + 32);
-          buf.insert(buf.end(), result.begin(), result.end());
-          for (int i = 7; i >= 0; --i)
-            buf.push_back((uint8_t)(seq >> (8 * i)));
-          blake2b_256(state_digest_, buf.data(), buf.size());
-        }
-        last_timestamp_[req.client] = req.timestamp;
-        ClientReply reply;
-        reply.view = view;
-        reply.timestamp = req.timestamp;
-        reply.client = req.client;
-        reply.replica = id_;
-        reply.result = result;
-        reply = sign(reply);  // §4.1: a reply vote must prove its caster
-        last_reply_[req.client] = reply;
-        out.replies.push_back({req.client, reply});
+        continue;
       }
+      // Execution: the reference's app is a no-op returning "awesome!"
+      // (reference src/message.rs:70); kept as the built-in default —
+      // a stateful app overrides via the app_execute hook.
+      std::string result =
+          app_execute ? app_execute(req.operation, seq) : "awesome!";
+      counters["executed"] += 1;
+      {
+        std::vector<uint8_t> buf(state_digest_, state_digest_ + 32);
+        buf.insert(buf.end(), result.begin(), result.end());
+        for (int i = 7; i >= 0; --i)
+          buf.push_back((uint8_t)(seq >> (8 * i)));
+        blake2b_256(state_digest_, buf.data(), buf.size());
+      }
+      last_timestamp_[req.client] = req.timestamp;
+      ClientReply reply;
+      reply.view = view;
+      reply.timestamp = req.timestamp;
+      reply.client = req.client;
+      reply.replica = id_;
+      reply.result = result;
+      reply = sign(reply);  // §4.1: a reply vote must prove its caster
+      last_reply_[req.client] = reply;
+      out.replies.push_back({req.client, reply});
     }
     if (seq % config_.checkpoint_interval == 0) {
       std::string payload = checkpoint_payload(seq);
@@ -693,7 +725,7 @@ bool Replica::validate_view_change(const ViewChange& vc) const {
     auto* pp = std::get_if<PrePrepare>(&*ppm);
     if (!pp || pp->seq <= vc.last_stable_seq) return false;
     int64_t prim = config_.primary_of(pp->view);
-    if (pp->replica != prim || pp->request.digest_hex() != pp->digest)
+    if (pp->replica != prim || pp->batch_digest() != pp->digest)
       return false;
     if (!verify_inline(prim, *ppm, pp->sig)) return false;
     std::set<int64_t> seen;
@@ -744,8 +776,19 @@ std::pair<int64_t, std::vector<Replica::OEntry>> Replica::compute_o(
     const std::vector<ViewChange>& vcs) const {
   int64_t min_s = 0;
   for (const auto& vc : vcs) min_s = std::max(min_s, vc.last_stable_seq);
-  // seq -> (view, digest, request json)
-  std::map<int64_t, std::tuple<int64_t, std::string, Json>> best;
+  // seq -> (view, digest, request batch)
+  std::map<int64_t, std::tuple<int64_t, std::string, std::vector<ClientRequest>>>
+      best;
+  auto parse_one = [](const Json& rj, std::vector<ClientRequest>* out) {
+    if (rj.is_object() && rj.find("operation") && rj.find("timestamp") &&
+        rj.find("client")) {
+      ClientRequest parsed;
+      parsed.operation = rj.find("operation")->as_string();
+      parsed.timestamp = rj.find("timestamp")->as_int();
+      parsed.client = rj.find("client")->as_string();
+      out->push_back(std::move(parsed));
+    }
+  };
   for (const auto& vc : vcs) {
     for (const Json& proof : vc.prepared_proofs) {
       const Json* ppd = proof.find("pre_prepare");
@@ -753,13 +796,21 @@ std::pair<int64_t, std::vector<Replica::OEntry>> Replica::compute_o(
       const Json* seqj = ppd->find("seq");
       const Json* viewj = ppd->find("view");
       const Json* digj = ppd->find("digest");
-      const Json* reqj = ppd->find("request");
-      if (!seqj || !viewj || !digj || !reqj) continue;
+      if (!seqj || !viewj || !digj) continue;
       int64_t n = seqj->as_int();
       if (n <= min_s) continue;
       auto it = best.find(n);
       if (it == best.end() || viewj->as_int() > std::get<0>(it->second)) {
-        best[n] = {viewj->as_int(), digj->as_string(), *reqj};
+        // Legacy evidence carries the singular `request`; batched
+        // evidence the `requests` list. The whole batch rides along.
+        std::vector<ClientRequest> reqs;
+        if (const Json* reqj = ppd->find("request")) {
+          parse_one(*reqj, &reqs);
+        } else if (const Json* reqsj = ppd->find("requests");
+                   reqsj && reqsj->is_array()) {
+          for (const Json& rj : reqsj->as_array()) parse_one(rj, &reqs);
+        }
+        best[n] = {viewj->as_int(), digj->as_string(), std::move(reqs)};
       }
     }
   }
@@ -768,18 +819,12 @@ std::pair<int64_t, std::vector<Replica::OEntry>> Replica::compute_o(
   for (int64_t n = min_s + 1; n <= max_s; ++n) {
     auto it = best.find(n);
     if (it != best.end()) {
-      ClientRequest req;
-      const Json& rj = std::get<2>(it->second);
-      ClientRequest parsed;
-      if (rj.is_object() && rj.find("operation") && rj.find("timestamp") &&
-          rj.find("client")) {
-        parsed.operation = rj.find("operation")->as_string();
-        parsed.timestamp = rj.find("timestamp")->as_int();
-        parsed.client = rj.find("client")->as_string();
-      }
-      entries.push_back({n, std::get<1>(it->second), parsed});
+      entries.push_back(
+          {n, std::get<1>(it->second), std::get<2>(it->second)});
     } else {
-      entries.push_back({n, null_request().digest_hex(), std::nullopt});
+      // Gap filler: an EMPTY batch (the batched form of §4.4's null
+      // request) — execution is a no-op, the sequence still advances.
+      entries.push_back({n, batch_digest_hex({}), {}});
     }
   }
   return {min_s, entries};
@@ -816,7 +861,7 @@ Actions Replica::maybe_new_view(int64_t v) {
     pp.view = v;
     pp.seq = e.seq;
     pp.digest = e.digest;
-    pp.request = e.request ? *e.request : null_request();
+    pp.requests = e.requests;
     pp.replica = id_;
     pps.push_back(sign(pp));
   }
@@ -864,7 +909,7 @@ Actions Replica::on_new_view(const NewView& nv) {
     if (pp->view != nv.new_view || pp->seq != entries[i].seq ||
         pp->digest != entries[i].digest || pp->replica != nv.replica)
       return {};
-    if (pp->request.digest_hex() != pp->digest) return {};
+    if (pp->batch_digest() != pp->digest) return {};
     if (!verify_inline(pp->replica, *m, pp->sig)) return {};
     pps.push_back(*pp);
   }

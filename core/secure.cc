@@ -171,8 +171,11 @@ bool SecureChannel::check_version(const Json& obj, std::string* err) {
   const Json* v = obj.find("ver");
   std::string ver = v && v->is_string() ? v->as_string() : "<none>";
   // Compatible set, not exact match: 1.1.0 only ADDS the negotiated
-  // binary codec, so 1.0.0 peers interoperate (JSON frames both ways).
-  if (ver != kProtocolVersion && ver != kProtocolVersionLegacy) {
+  // binary codec and 1.2.0 the batched pre-prepare (batch=1 frames are
+  // byte-identical), so older peers interoperate (JSON both ways for
+  // 1.0.0; bin2 batch=1 for 1.1.0).
+  if (ver != kProtocolVersion && ver != kProtocolVersionBin2 &&
+      ver != kProtocolVersionLegacy) {
     *err = "protocol version mismatch: peer speaks '" + ver +
            "', this node speaks '" + kProtocolVersion + "'";
     return false;

@@ -22,7 +22,12 @@ namespace pbft {
 // 1.0.0 peers stay interoperable — the hello's ver gates what a sender
 // may offer, and the transcript binds to the initiator's advertised
 // version so mixed-version secure handshakes still agree on the bytes.
-inline constexpr const char* kProtocolVersion = "pbft-tpu/1.1.0";
+// 1.2.0 adds the batched pre-prepare (binary 0x06 / JSON `requests`,
+// ISSUE 4); batch=1 frames stay byte-identical to 1.1.0, so 1.1.0 and
+// 1.0.0 peers remain in the compatible set — a batching primary simply
+// must not be pointed at them with batch_max_items > 1.
+inline constexpr const char* kProtocolVersion = "pbft-tpu/1.2.0";
+inline constexpr const char* kProtocolVersionBin2 = "pbft-tpu/1.1.0";
 inline constexpr const char* kProtocolVersionLegacy = "pbft-tpu/1.0.0";
 inline constexpr size_t kTagLen = 16;
 

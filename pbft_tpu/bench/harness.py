@@ -38,6 +38,14 @@ class BenchResult:
     byzantine: bool = False
     pipeline: int = 1  # in-flight requests per nominal client (native arms)
     service_inflight: int = 1  # overlapped service launches (native-tpu arm)
+    # Request batching (ISSUE 4): with batch_max_items > 1 the unit of
+    # agreement is a batch, so requests/sec and rounds/sec diverge —
+    # mean_batch (requests executed / rounds executed, from the replicas'
+    # own counters) is the measured amplification between them.
+    requests_per_sec: float = 0.0
+    mean_batch: float = 1.0
+    batch_max_items: int = 1
+    batch_flush_us: int = 0
 
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self))
@@ -133,6 +141,13 @@ def run_config(
             if submitted >= reqs_total and not inflight:
                 break
     elapsed = time.perf_counter() - t0
+    rounds = max(
+        (r.counters.get("rounds_executed", 0) for r in cluster.replicas),
+        default=0,
+    )
+    executed = max(
+        (r.counters.get("executed", 0) for r in cluster.replicas), default=0
+    )
     return BenchResult(
         config=name,
         replicas=n,
@@ -140,11 +155,13 @@ def run_config(
         clients=clients,
         requests=reqs_total,
         seconds=round(elapsed, 3),
-        rounds_per_sec=round(reqs_total / elapsed, 1),
+        rounds_per_sec=round((rounds or reqs_total) / elapsed, 1),
         sig_verifies_per_sec=round(cluster.sig_verifications / elapsed, 1),
         sig_verifications=cluster.sig_verifications,
         verifier=arm,
         byzantine=byzantine,
+        requests_per_sec=round(reqs_total / elapsed, 1),
+        mean_batch=round(executed / rounds, 2) if rounds else 1.0,
     )
 
 
@@ -158,6 +175,8 @@ def run_native_config(
     pipeline: Optional[int] = None,
     flush_us: int = 0,
     flush_items: int = 0,
+    batch_max_items: int = 1,
+    batch_flush_us: int = 0,
 ) -> BenchResult:
     """The same config driven through REAL pbftd processes over loopback
     TCP (framed wire protocol, dial-back replies) instead of the in-memory
@@ -179,11 +198,19 @@ def run_native_config(
     name, n, clients, default_requests, byzantine = CONFIGS[index]
     if pipeline is None:
         pipeline = PIPELINE.get(index, 1)
-    workers = clients * pipeline
-    # The native runtime pipelines across rounds, so give it enough
-    # requests to measure steady state even on the demo config (and at
-    # least a few rounds per in-flight slot when pipelined).
-    reqs_total = requests or max(default_requests, 100, workers * 6)
+    # Pipelined load generators (PbftClient.request_many): each worker
+    # streams a WINDOW of requests over one connection — the
+    # windowed-async shape that actually fills the primary's request
+    # batches (ISSUE 4). The pipeline depth is split across several
+    # worker identities (window <= 8 each) because every reply is dialed
+    # back per address with per-address serialization — one identity
+    # carrying the whole pipeline would measure the reply dialer, not
+    # the protocol. (The former drive used clients x pipeline lock-step
+    # threads: same concurrency, one request per client per round trip,
+    # which can never fill a batch from one client.)
+    window = min(pipeline, 8)
+    workers = clients * max(1, (pipeline + window - 1) // window)
+    reqs_total = requests or max(default_requests, 100, clients * pipeline * 6)
     per_worker = max(1, reqs_total // workers)
     reqs_total = per_worker * workers
     if trace_dir:
@@ -202,6 +229,8 @@ def run_native_config(
         secure=secure,
         verify_flush_us=flush_us,
         verify_flush_items=flush_items,
+        batch_max_items=batch_max_items,
+        batch_flush_us=batch_flush_us,
     ) as cluster:
         f_val = cluster.config.f
         handles = [PbftClient(cluster.config) for _ in range(workers)]
@@ -213,10 +242,11 @@ def run_native_config(
         t0 = time.perf_counter()
 
         def drive(ci: int) -> None:
-            c = handles[ci]
-            for k in range(per_worker):
-                req = c.request(f"op-{ci}-{k}")
-                c.wait_result(req.timestamp, timeout=60)
+            handles[ci].request_many(
+                [f"op-{ci}-{k}" for k in range(per_worker)],
+                window=window,
+                timeout=60,
+            )
 
         threads = [
             threading.Thread(target=drive, args=(i,)) for i in range(workers)
@@ -228,17 +258,35 @@ def run_native_config(
         elapsed = time.perf_counter() - t0
         for c in handles:
             c.close()
-        # Total signature verifications across the cluster, from each
-        # replica's last metrics line (core/net.cc metrics_json).
+        # Cluster-wide counters from each replica's last metrics line
+        # (core/net.cc metrics_json / server.py metrics): signature
+        # verifications, plus requests vs rounds executed — their ratio
+        # is the measured batch occupancy.
         sig_total = 0
+        executed_total = 0
+        rounds_total = 0
+        rounds_max = 0
         time.sleep(1.5)  # one more metrics tick so counters are current
         for i in range(n):
             log = (Path(cluster.tmpdir.name) / f"replica-{i}.log").read_text(
                 errors="ignore"
             )
-            found = re.findall(r'"sig_verified":(\d+)', log)
-            if found:
-                sig_total += int(found[-1])
+            for pattern, sink in (
+                (r'"sig_verified":\s*(\d+)', "sig"),
+                (r'"executed":\s*(\d+)', "executed"),
+                (r'"rounds_executed":\s*(\d+)', "rounds"),
+            ):
+                found = re.findall(pattern, log)
+                if not found:
+                    continue
+                val = int(found[-1])
+                if sink == "sig":
+                    sig_total += val
+                elif sink == "executed":
+                    executed_total += val
+                else:
+                    rounds_total += val
+                    rounds_max = max(rounds_max, val)
     return BenchResult(
         config=name,
         replicas=n,
@@ -246,12 +294,23 @@ def run_native_config(
         clients=clients,
         requests=reqs_total,
         seconds=round(elapsed, 3),
-        rounds_per_sec=round(reqs_total / elapsed, 1),
+        # rounds/sec = three-phase instances completed (includes the one
+        # warmup round); requests/sec = driven requests over the timed
+        # region. With batch_max_items=1 the two coincide.
+        rounds_per_sec=round(
+            (rounds_max or reqs_total) / elapsed, 1
+        ),
         sig_verifies_per_sec=round(sig_total / elapsed, 1),
         sig_verifications=sig_total,
         verifier=tag or ("native-secure" if secure else "native"),
         byzantine=byzantine,
         pipeline=pipeline,
+        requests_per_sec=round(reqs_total / elapsed, 1),
+        mean_batch=(
+            round(executed_total / rounds_total, 2) if rounds_total else 1.0
+        ),
+        batch_max_items=batch_max_items,
+        batch_flush_us=batch_flush_us,
     )
 
 
@@ -291,6 +350,8 @@ def run_native_tpu_config(
     flush_items: int = 0,
     service_backend: str = "jax",
     service_inflight: int = 1,
+    batch_max_items: int = 1,
+    batch_flush_us: int = 0,
 ) -> BenchResult:
     """run_native_config against one coalescing VerifierService shared by
     every daemon — the TPU deployment shape (N replicas on one host, one
@@ -329,6 +390,8 @@ def run_native_tpu_config(
             trace_dir=trace_dir,
             secure=secure,
             pipeline=pipeline,
+            batch_max_items=batch_max_items,
+            batch_flush_us=batch_flush_us,
         )
         # Recorded in the artifact: rows captured at different overlap
         # settings must never be compared as like-for-like.
@@ -385,6 +448,19 @@ def main() -> None:
         help="flush early once this many items are pending (0 = pad/window cap)",
     )
     parser.add_argument(
+        "--batch-max-items",
+        type=int,
+        default=1,
+        help="requests the primary folds into one three-phase instance "
+        "(native arms; ISSUE 4 batching — requests/sec vs rounds/sec)",
+    )
+    parser.add_argument(
+        "--batch-flush-us",
+        type=int,
+        default=0,
+        help="partial-batch flush deadline, microseconds (native arms)",
+    )
+    parser.add_argument(
         "--service-backend",
         default="jax",
         choices=["jax", "cpu", "native"],
@@ -412,6 +488,8 @@ def main() -> None:
                     flush_items=args.flush_items,
                     service_backend=args.service_backend,
                     service_inflight=args.service_inflight,
+                    batch_max_items=args.batch_max_items,
+                    batch_flush_us=args.batch_flush_us,
                 ).to_json()
             )
         elif args.arm == "native":
@@ -424,6 +502,8 @@ def main() -> None:
                     pipeline=args.pipeline,
                     flush_us=args.flush_us,
                     flush_items=args.flush_items,
+                    batch_max_items=args.batch_max_items,
+                    batch_flush_us=args.batch_flush_us,
                 ).to_json()
             )
         else:

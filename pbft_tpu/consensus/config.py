@@ -40,6 +40,16 @@ class ClusterConfig:
     # per verifier launch). 0 = flush every event-loop pass.
     verify_flush_us: int = 0
     verify_flush_items: int = 0
+    # Request batching (ISSUE 4): the primary accumulates client requests
+    # into an ordered batch and runs ONE three-phase instance per batch.
+    # batch_max_items caps the batch (1 = the pre-batching one-instance-
+    # per-request protocol, wire-compatible with 1.1.0 peers);
+    # batch_flush_us bounds how long a partial batch may wait for more
+    # requests before the runtime seals it (0 = seal on the next
+    # event-loop pass). Backups ignore both: batch composition is the
+    # primary's choice, acceptance is size-agnostic.
+    batch_max_items: int = 1
+    batch_flush_us: int = 0
     verifier: str = "cpu"  # "cpu" | "tpu"
     # Encrypted replica-replica links (signed-ephemeral DH + AEAD framing,
     # pbft_tpu/net/secure.py) — the reference's development_transport
@@ -68,6 +78,8 @@ class ClusterConfig:
                 "batch_pad": self.batch_pad,
                 "verify_flush_us": self.verify_flush_us,
                 "verify_flush_items": self.verify_flush_items,
+                "batch_max_items": self.batch_max_items,
+                "batch_flush_us": self.batch_flush_us,
                 "verifier": self.verifier,
                 "secure": self.secure,
                 "replicas": [dataclasses.asdict(r) for r in self.replicas],
@@ -85,6 +97,8 @@ class ClusterConfig:
             batch_pad=d.get("batch_pad", 64),
             verify_flush_us=d.get("verify_flush_us", 0),
             verify_flush_items=d.get("verify_flush_items", 0),
+            batch_max_items=d.get("batch_max_items", 1),
+            batch_flush_us=d.get("batch_flush_us", 0),
             verifier=d.get("verifier", "cpu"),
             secure=bool(d.get("secure", False)),
         )

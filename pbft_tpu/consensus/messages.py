@@ -73,10 +73,32 @@ class Message:
         d = dict(d)
         typ = d.pop("type")
         target = Message._REGISTRY[typ]
-        if "request" in d and isinstance(d["request"], dict):
-            req = dict(d["request"])
-            req.pop("type", None)
-            d["request"] = ClientRequest(**req)
+
+        def _req(rd) -> "ClientRequest":
+            rd = dict(rd)
+            rd.pop("type", None)
+            return ClientRequest(**rd)
+
+        if target is PrePrepare:
+            # Legacy singular `request` (batch of one) and the batched
+            # `requests` list both decode to the requests tuple. A
+            # one-element `requests` list is REJECTED, exactly like the
+            # C++ parser: each batch has one canonical encoding, and
+            # admitting the other form here while the native runtime
+            # drops it would be a cross-runtime consensus divergence.
+            if "request" in d and isinstance(d["request"], dict):
+                d["requests"] = (_req(d.pop("request")),)
+            elif isinstance(d.get("requests"), (list, tuple)):
+                if len(d["requests"]) == 1:
+                    raise ValueError(
+                        "one-element `requests` must encode as `request`"
+                    )
+                d["requests"] = tuple(
+                    _req(r) if isinstance(r, dict) else r
+                    for r in d["requests"]
+                )
+        elif "request" in d and isinstance(d["request"], dict):
+            d["request"] = _req(d["request"])
         return target(**d)
 
 
@@ -143,18 +165,61 @@ class ClientReply(Message):
     sig: str = ""
 
 
+def batch_digest(requests) -> str:
+    """The pre-prepare content digest over an ordered request batch.
+
+    A batch of exactly one request keeps the legacy definition — the
+    digest of that request's canonical bytes — so batch=1 pre-prepares
+    are byte-identical (wire AND signable) to pre-batching peers. Any
+    other size (including the empty batch, the new-view gap filler)
+    digests the CONCATENATED per-request digests with Blake2b-256:
+    order-sensitive, and collision-free down to the per-request digests."""
+    if len(requests) == 1:
+        return requests[0].digest()
+    return blake2b_256(
+        b"".join(bytes.fromhex(r.digest()) for r in requests)
+    ).hex()
+
+
 @dataclasses.dataclass(frozen=True)
 class PrePrepare(Message):
-    """<<PRE-PREPARE, v, n, d>, m> signed by the primary
-    (reference src/message.rs:106-137)."""
+    """<<PRE-PREPARE, v, n, d>, M> signed by the primary
+    (reference src/message.rs:106-137), where M is an ordered request
+    BATCH agreed under one sequence number (Castro & Liskov's batching
+    amplifier). ``digest`` is batch_digest(requests). A batch of one
+    encodes with the legacy singular ``request`` member (canonical JSON
+    and binary alike) for wire compatibility with pre-batching peers;
+    any other size uses the ``requests`` list / the 0x06 binary layout."""
 
     TYPE: ClassVar[str] = "pre-prepare"
     view: int
     seq: int
     digest: str
-    request: ClientRequest
+    requests: tuple  # tuple[ClientRequest, ...]
     replica: int
     sig: str = ""
+
+    def __post_init__(self):
+        object.__setattr__(self, "requests", tuple(self.requests))
+
+    def to_dict(self) -> dict:
+        d = {
+            "view": self.view,
+            "seq": self.seq,
+            "digest": self.digest,
+            "replica": self.replica,
+            "sig": self.sig,
+            "type": self.TYPE,
+        }
+        reqs = [dataclasses.asdict(r) for r in self.requests]
+        if len(reqs) == 1:
+            d["request"] = reqs[0]
+        else:
+            d["requests"] = reqs
+        return d
+
+    def batch_digest(self) -> str:
+        return batch_digest(self.requests)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -331,21 +396,33 @@ def _signable_bytes_fast(msg: "Message") -> Optional[bytes]:
             ).encode()
         return None
     if t is PrePrepare:
-        req = msg.request
-        if (
+        reqs = msg.requests
+        if not (
             type(msg.view) is int and type(msg.seq) is int
             and type(msg.replica) is int and type(msg.digest) is str
-            and type(req) is ClientRequest and type(req.timestamp) is int
-            and type(req.operation) is str and type(req.client) is str
+            and type(reqs) is tuple
+            and all(
+                type(r) is ClientRequest and type(r.timestamp) is int
+                and type(r.operation) is str and type(r.client) is str
+                for r in reqs
+            )
         ):
+            return None
+        def _req_body(r):
             return (
-                f'{{"digest":{_dumps(msg.digest)},"replica":{msg.replica},'
-                f'"request":{{"client":{_dumps(req.client)},'
-                f'"operation":{_dumps(req.operation)},'
-                f'"timestamp":{req.timestamp}}},"seq":{msg.seq},'
-                f'"type":"pre-prepare","view":{msg.view}}}'
-            ).encode()
-        return None
+                f'{{"client":{_dumps(r.client)},'
+                f'"operation":{_dumps(r.operation)},'
+                f'"timestamp":{r.timestamp}}}'
+            )
+        if len(reqs) == 1:
+            member = f'"request":{_req_body(reqs[0])}'
+        else:
+            member = '"requests":[' + ",".join(_req_body(r) for r in reqs) + "]"
+        return (
+            f'{{"digest":{_dumps(msg.digest)},"replica":{msg.replica},'
+            f'{member},"seq":{msg.seq},'
+            f'"type":"pre-prepare","view":{msg.view}}}'
+        ).encode()
     if t is ClientRequest:
         if (
             type(msg.timestamp) is int and type(msg.operation) is str
@@ -412,6 +489,12 @@ _BIN_PRE_PREPARE = 0x02
 _BIN_PREPARE = 0x03
 _BIN_COMMIT = 0x04
 _BIN_CHECKPOINT = 0x05
+# Batched pre-prepare (ISSUE 4): same header as 0x02 but the request
+# payload is a u32 count followed by that many {operation, timestamp,
+# client} groups. Batches of exactly one keep emitting 0x02, so a
+# batch=1 cluster's frames are byte-identical to pre-batching peers.
+_BIN_PRE_PREPARE_BATCH = 0x06
+_BIN_MAX_BATCH = 1 << 16
 
 
 def _i64(v: int) -> bytes:
@@ -449,13 +532,26 @@ def to_binary(msg: Message) -> Optional[bytes]:
             sig = _b_hex(msg.sig, 64)
             if digest is None or sig is None:
                 return None
-            req = msg.request
-            return (
-                bytes((WIRE_BINARY_MAGIC, _BIN_PRE_PREPARE))
-                + _i64(msg.view) + _i64(msg.seq) + digest
+            head = (
+                _i64(msg.view) + _i64(msg.seq) + digest
                 + _i64(msg.replica) + sig
-                + _b_str(req.operation) + _i64(req.timestamp)
-                + _b_str(req.client)
+            )
+            if len(msg.requests) == 1:
+                req = msg.requests[0]
+                return (
+                    bytes((WIRE_BINARY_MAGIC, _BIN_PRE_PREPARE)) + head
+                    + _b_str(req.operation) + _i64(req.timestamp)
+                    + _b_str(req.client)
+                )
+            if len(msg.requests) > _BIN_MAX_BATCH:
+                return None
+            body = len(msg.requests).to_bytes(4, "big") + b"".join(
+                _b_str(r.operation) + _i64(r.timestamp) + _b_str(r.client)
+                for r in msg.requests
+            )
+            return (
+                bytes((WIRE_BINARY_MAGIC, _BIN_PRE_PREPARE_BATCH))
+                + head + body
             )
         if t is Prepare or t is Commit:
             digest = _b_hex(msg.digest, 32)
@@ -521,16 +617,31 @@ def from_binary(payload: bytes) -> Message:
         msg: Message = ClientRequest(
             operation=r.str_(), timestamp=r.i64(), client=r.str_()
         )
-    elif code == _BIN_PRE_PREPARE:
+    elif code in (_BIN_PRE_PREPARE, _BIN_PRE_PREPARE_BATCH):
         view, seq = r.i64(), r.i64()
         digest = r.hex_(32)
         replica = r.i64()
         sig = r.hex_(64)
-        req = ClientRequest(
-            operation=r.str_(), timestamp=r.i64(), client=r.str_()
-        )
+        if code == _BIN_PRE_PREPARE:
+            reqs = (
+                ClientRequest(
+                    operation=r.str_(), timestamp=r.i64(), client=r.str_()
+                ),
+            )
+        else:
+            count = int.from_bytes(r._take(4), "big")
+            if count > _BIN_MAX_BATCH or count == 1:
+                # count==1 must encode as 0x02 (one canonical form per
+                # message, or signable digests would fork).
+                raise ValueError("invalid batched pre-prepare count")
+            reqs = tuple(
+                ClientRequest(
+                    operation=r.str_(), timestamp=r.i64(), client=r.str_()
+                )
+                for _ in range(count)
+            )
         msg = PrePrepare(
-            view=view, seq=seq, digest=digest, request=req,
+            view=view, seq=seq, digest=digest, requests=reqs,
             replica=replica, sig=sig,
         )
     elif code in (_BIN_PREPARE, _BIN_COMMIT):

@@ -46,8 +46,8 @@ from .messages import (
     StateResponse,
     ViewChange,
     _canonical_json,
+    batch_digest,
     blake2b_256,
-    null_request,
     with_sig,
 )
 
@@ -155,6 +155,16 @@ class Replica:
         # it. None (the default) costs one attribute check per transition,
         # never per message (the Tracer discipline, utils/trace.py).
         self.phase_hook: Optional[Callable[[str, int, int], None]] = None
+        # Batch-size observer: called with len(pp.requests) at every
+        # pre-prepare accept (feeds the pbft_batch_size histogram). Same
+        # one-attribute-check-when-unset discipline as phase_hook.
+        self.batch_hook: Optional[Callable[[int], None]] = None
+        # The primary's OPEN batch (ISSUE 4): requests accumulated but not
+        # yet sealed under a sequence number. _open_batch_ts tracks the
+        # highest pending timestamp per client so duplicate suppression
+        # also sees requests that sit in the unsealed batch.
+        self._open_batch: List[ClientRequest] = []
+        self._open_batch_ts: Dict[str, int] = {}
         self.counters: Dict[str, int] = {
             "sig_verified": 0,
             "sig_rejected": 0,
@@ -162,6 +172,7 @@ class Replica:
             "prepares_accepted": 0,
             "commits_accepted": 0,
             "executed": 0,
+            "rounds_executed": 0,
             "duplicate_requests": 0,
             "checkpoints_stable": 0,
             "view_changes_started": 0,
@@ -207,8 +218,40 @@ class Replica:
             if cached is not None and cached.timestamp == req.timestamp:
                 return [Reply(req.client, cached)]
             return []
+        # Duplicate suppression must also see the OPEN batch: a
+        # retransmission arriving while its first copy waits unsealed
+        # would otherwise be ordered (and executed) twice... well, once —
+        # the execution-time exactly-once guard catches it — but it would
+        # burn batch slots and inflate sequence traffic for nothing.
+        pending = self._open_batch_ts.get(req.client)
+        if pending is not None and req.timestamp <= pending:
+            self.counters["duplicate_requests"] += 1
+            return []
+        self._open_batch.append(req)
+        self._open_batch_ts[req.client] = req.timestamp
+        if len(self._open_batch) >= max(1, self.config.batch_max_items):
+            return self._seal_batch()
+        return []  # the runtime's batch_flush_us timer seals partials
+
+    def open_batch_size(self) -> int:
+        """Requests waiting in the unsealed batch — the runtime's flush
+        timer (config.batch_flush_us) polls this."""
+        return len(self._open_batch)
+
+    def flush_open_batch(self) -> List[Action]:
+        """Seal the open batch regardless of occupancy (runtime flush
+        timer). No-op while empty or while the watermark window is
+        closed (the batch stays open; retried on the next tick)."""
+        if not self._open_batch:
+            return []
+        return self._seal_batch()
+
+    def _seal_batch(self) -> List[Action]:
         if self.seq_counter + 1 > self.high_mark:
             return []  # out of window until a checkpoint advances it
+        batch = tuple(self._open_batch)
+        self._open_batch = []
+        self._open_batch_ts = {}
         self.seq_counter += 1
         n = self.seq_counter
         hook = self.phase_hook
@@ -218,8 +261,8 @@ class Replica:
             PrePrepare(
                 view=self.view,
                 seq=n,
-                digest=req.digest(),
-                request=req,
+                digest=batch_digest(batch),
+                requests=batch,
                 replica=self.id,
             )
         )
@@ -312,7 +355,7 @@ class Replica:
             return []  # §4.4: only checkpoint/view-change/new-view accepted
         if pp.view != self.view or pp.replica != self.primary:
             return []
-        if pp.request.digest() != pp.digest:
+        if pp.batch_digest() != pp.digest:
             return []
         if not (self.low_mark < pp.seq <= self.high_mark):
             return []
@@ -328,6 +371,9 @@ class Replica:
         hook = self.phase_hook
         if hook is not None:
             hook("pre_prepare", pp.view, pp.seq)
+        bhook = self.batch_hook
+        if bhook is not None:
+            bhook(len(pp.requests))
         # The primary's pre-prepare stands in for its prepare (PBFT §4.2):
         # only backups multicast PREPARE, and _prepared wants 2f *backup*
         # prepares, giving 2f+1 distinct replicas per certificate.
@@ -446,41 +492,52 @@ class Replica:
                 # (the old way to get here) now goes through state transfer
                 # (_on_state_response) instead of skipping executions.
                 continue
-            req = pp.request
-            if req.client == NULL_CLIENT:
-                # Null request (view-change gap filler, PBFT §4.4): the
-                # execution is a no-op and nobody awaits a reply, but it
-                # still advances the sequence and the state digest chain.
+            self.counters["rounds_executed"] += 1
+            if not pp.requests:
+                # Empty batch (view-change gap filler, PBFT §4.4's null
+                # request as a batch): a no-op execution that still
+                # advances the sequence and the state digest chain — the
+                # SAME chain fold a legacy single null request produced,
+                # so the two gap-filler encodings cannot fork app state.
                 self.state_digest = hashlib.blake2b(
                     self.state_digest + b"<null>" + seq.to_bytes(8, "big"),
                     digest_size=32,
                 ).digest()
-            else:
-                last = self.last_timestamp.get(req.client)
-                if last is not None and req.timestamp <= last:
-                    # exactly-once (reference src/behavior.rs:391-398)
-                    self.counters["duplicate_requests"] += 1
-                else:
-                    result = self._app(req.operation, seq)
-                    self.counters["executed"] += 1
+            for req in pp.requests:
+                if req.client == NULL_CLIENT:
+                    # Legacy null request (a 1.1.0 peer's gap filler riding
+                    # a batch of one): no-op, no reply, chain advances.
                     self.state_digest = hashlib.blake2b(
-                        self.state_digest
-                        + result.encode()
-                        + seq.to_bytes(8, "big"),
+                        self.state_digest + b"<null>" + seq.to_bytes(8, "big"),
                         digest_size=32,
                     ).digest()
-                    self.last_timestamp[req.client] = req.timestamp
-                    reply = self._sign(
-                        ClientReply(
-                            view=view,
-                            timestamp=req.timestamp,
-                            client=req.client,
-                            replica=self.id,
-                            result=result,
-                        )
+                    continue
+                last = self.last_timestamp.get(req.client)
+                if last is not None and req.timestamp <= last:
+                    # exactly-once (reference src/behavior.rs:391-398),
+                    # enforced per batch item in batch order.
+                    self.counters["duplicate_requests"] += 1
+                    continue
+                result = self._app(req.operation, seq)
+                self.counters["executed"] += 1
+                self.state_digest = hashlib.blake2b(
+                    self.state_digest
+                    + result.encode()
+                    + seq.to_bytes(8, "big"),
+                    digest_size=32,
+                ).digest()
+                self.last_timestamp[req.client] = req.timestamp
+                reply = self._sign(
+                    ClientReply(
+                        view=view,
+                        timestamp=req.timestamp,
+                        client=req.client,
+                        replica=self.id,
+                        result=result,
                     )
-                    self.last_reply[req.client] = reply
-                    out.append(Reply(req.client, reply))
+                )
+                self.last_reply[req.client] = reply
+                out.append(Reply(req.client, reply))
             if seq % self.config.checkpoint_interval == 0:
                 payload = self._checkpoint_payload(seq)
                 self.snapshots[seq] = payload
@@ -679,7 +736,7 @@ class Replica:
             for d in vc.checkpoint_proof:
                 try:
                     cp = Message.from_dict(dict(d))
-                except (KeyError, TypeError):
+                except (KeyError, TypeError, ValueError):
                     return False
                 if not isinstance(cp, Checkpoint) or cp.seq != vc.last_stable_seq:
                     return False
@@ -695,12 +752,12 @@ class Replica:
             try:
                 pp = Message.from_dict(dict(proof["pre_prepare"]))
                 preps = [Message.from_dict(dict(p)) for p in proof["prepares"]]
-            except (KeyError, TypeError):
+            except (KeyError, TypeError, ValueError):
                 return False
             if not isinstance(pp, PrePrepare) or pp.seq <= vc.last_stable_seq:
                 return False
             primary = self.config.primary_of(pp.view)
-            if pp.replica != primary or pp.request.digest() != pp.digest:
+            if pp.replica != primary or pp.batch_digest() != pp.digest:
                 return False
             if not self._verify_inline(primary, pp.signable(), pp.sig):
                 return False
@@ -747,11 +804,14 @@ class Replica:
 
     def _compute_o(
         self, vcs: List[ViewChange]
-    ) -> Tuple[int, List[Tuple[int, str, Optional[dict]]]]:
-        """(min_s, [(seq, digest, request_dict|None)]) — the O computation:
-        re-issue every sequence some quorum member prepared; null-fill gaps."""
+    ) -> Tuple[int, List[Tuple[int, str, List[dict]]]]:
+        """(min_s, [(seq, digest, request_dicts)]) — the O computation:
+        re-issue every sequence some quorum member prepared (the whole
+        request BATCH rides along in the prepared proof); gaps are filled
+        with EMPTY batches (the batched form of PBFT §4.4's null
+        request — execution is a no-op, the sequence still advances)."""
         min_s = max(vc.last_stable_seq for vc in vcs)
-        best: Dict[int, Tuple[int, str, dict]] = {}
+        best: Dict[int, Tuple[int, str, List[dict]]] = {}
         for vc in vcs:
             for proof in vc.prepared_proofs:
                 ppd = dict(proof["pre_prepare"])
@@ -759,14 +819,22 @@ class Replica:
                 if n <= min_s:
                     continue
                 if n not in best or ppd["view"] > best[n][0]:
-                    best[n] = (ppd["view"], ppd["digest"], ppd["request"])
-        entries: List[Tuple[int, str, Optional[dict]]] = []
+                    # Legacy evidence carries the singular `request`;
+                    # batched evidence the `requests` list.
+                    if "requests" in ppd:
+                        reqs = [dict(r) for r in ppd["requests"]]
+                    elif ppd.get("request") is not None:
+                        reqs = [dict(ppd["request"])]
+                    else:
+                        reqs = []
+                    best[n] = (ppd["view"], ppd["digest"], reqs)
+        entries: List[Tuple[int, str, List[dict]]] = []
         max_s = max(best) if best else min_s
         for n in range(min_s + 1, max_s + 1):
             if n in best:
                 entries.append((n, best[n][1], best[n][2]))
             else:
-                entries.append((n, null_request().digest(), None))
+                entries.append((n, batch_digest(()), []))
         return min_s, entries
 
     def _majority_digest(self, proof) -> Optional[str]:
@@ -814,15 +882,16 @@ class Replica:
                     view=v,
                     seq=n,
                     digest=digest,
-                    request=(
-                        ClientRequest(**{k: val for k, val in req.items() if k != "type"})
-                        if req is not None
-                        else null_request()
+                    requests=tuple(
+                        ClientRequest(
+                            **{k: val for k, val in r.items() if k != "type"}
+                        )
+                        for r in reqs
                     ),
                     replica=self.id,
                 )
             )
-            for n, digest, req in entries
+            for n, digest, reqs in entries
         ]
         nv = self._sign(
             NewView(
@@ -849,7 +918,7 @@ class Replica:
         try:
             vcs = [Message.from_dict(dict(d)) for d in nv.view_changes]
             pps = [Message.from_dict(dict(d)) for d in nv.pre_prepares]
-        except (KeyError, TypeError):
+        except (KeyError, TypeError, ValueError):
             return []
         # V: 2f+1 distinct, correctly signed, valid view-changes for this view.
         if len(vcs) < 2 * self.config.f + 1:
@@ -870,12 +939,12 @@ class Replica:
         min_s, entries = self._compute_o(vcs)
         if len(pps) != len(entries):
             return []
-        for pp, (n, digest, _req) in zip(pps, entries):
+        for pp, (n, digest, _reqs) in zip(pps, entries):
             if not isinstance(pp, PrePrepare):
                 return []
             if (pp.view, pp.seq, pp.digest) != (nv.new_view, n, digest):
                 return []
-            if pp.replica != nv.replica or pp.request.digest() != pp.digest:
+            if pp.replica != nv.replica or pp.batch_digest() != pp.digest:
                 return []
             if not self._verify_inline(pp.replica, pp.signable(), pp.sig):
                 return []

@@ -61,9 +61,28 @@ class LocalCluster:
         secure: bool = False,
         verify_flush_us: int = 0,
         verify_flush_items: int = 0,
+        batch_max_items: "int | List[int]" = 1,
+        batch_flush_us: "int | List[int]" = 0,
         extra_env: Optional[List[Optional[dict]]] = None,
     ):
         self.trace_dir = trace_dir
+        # Request batching (ISSUE 4): scalars land in network.json; lists
+        # become per-replica --batch-* CLI overrides (e.g. a batching
+        # primary among batch=1 peers for the mixed-mode interop test).
+        n_for_lists = (config.n if config is not None else n)
+        self.batch_max_items = (
+            batch_max_items
+            if isinstance(batch_max_items, list)
+            else [batch_max_items] * n_for_lists
+        )
+        self.batch_flush_us = (
+            batch_flush_us
+            if isinstance(batch_flush_us, list)
+            else [batch_flush_us] * n_for_lists
+        )
+        self._batch_scalar = not (
+            isinstance(batch_max_items, list) or isinstance(batch_flush_us, list)
+        )
         # Replica ids whose daemons corrupt every outgoing signature
         # (--byzantine, both runtimes; the real-daemon analogue of the
         # simulation's outbound mutator).
@@ -85,6 +104,12 @@ class LocalCluster:
                 secure=secure,
                 verify_flush_us=verify_flush_us,
                 verify_flush_items=verify_flush_items,
+                batch_max_items=(
+                    batch_max_items if self._batch_scalar else 1
+                ),
+                batch_flush_us=(
+                    batch_flush_us if self._batch_scalar else 0
+                ),
             )
         self.config = config
         self.seeds = seeds
@@ -143,6 +168,11 @@ class LocalCluster:
             ]
             if self.metrics_every:
                 cmd += ["--metrics-every", str(self.metrics_every)]
+            if not self._batch_scalar:
+                cmd += [
+                    "--batch-max-items", str(self.batch_max_items[i]),
+                    "--batch-flush-us", str(self.batch_flush_us[i]),
+                ]
             if self.vc_timeout_ms:
                 cmd += ["--vc-timeout-ms", str(self.vc_timeout_ms)]
             if self.discovery:

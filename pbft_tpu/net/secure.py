@@ -50,13 +50,21 @@ from ..consensus.messages import CODEC_BINARY2
 from ..crypto import ref
 
 # 1.1.0 adds the negotiated binary-v2 payload codec
-# (consensus/messages.py); 1.0.0 peers stay interoperable — the hello's
-# ver gates what a sender may offer, and the handshake transcript binds
-# to the initiator's advertised version so mixed-version secure
-# handshakes still agree on the signed bytes.
-PROTOCOL_VERSION = "pbft-tpu/1.1.0"
+# (consensus/messages.py); 1.2.0 adds the batched pre-prepare (binary
+# 0x06 / JSON `requests`, ISSUE 4) whose batch=1 frames stay
+# byte-identical to 1.1.0. Older peers stay interoperable — the hello's
+# ver gates what a sender may offer, the handshake transcript binds to
+# the initiator's advertised version so mixed-version secure handshakes
+# still agree on the signed bytes, and a batching primary simply must
+# not be pointed at pre-1.2.0 peers with batch_max_items > 1.
+PROTOCOL_VERSION = "pbft-tpu/1.2.0"
+PROTOCOL_VERSION_BIN2 = "pbft-tpu/1.1.0"
 PROTOCOL_VERSION_LEGACY = "pbft-tpu/1.0.0"
-_COMPATIBLE_VERSIONS = (PROTOCOL_VERSION, PROTOCOL_VERSION_LEGACY)
+_COMPATIBLE_VERSIONS = (
+    PROTOCOL_VERSION,
+    PROTOCOL_VERSION_BIN2,
+    PROTOCOL_VERSION_LEGACY,
+)
 
 
 def _wire_json_forced() -> bool:

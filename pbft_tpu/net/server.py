@@ -151,6 +151,14 @@ class AsyncReplicaServer:
             self.replica.phase_hook = self.spans.on_phase
         else:
             self.spans = None
+        if self.metrics_registry.enabled:
+            # Batch occupancy at every pre-prepare accept (ISSUE 4).
+            _batch_hist = self.metrics_registry.histogram("pbft_batch_size")
+            self.replica.batch_hook = _batch_hist.observe
+        # Last-seen replica execution counters, for the
+        # pbft_requests_executed_total / pbft_consensus_rounds_total deltas.
+        self._seen_executed = 0
+        self._seen_rounds = 0
         if callable(verifier):
             self.verify = verifier
         elif verifier == "jax":
@@ -195,6 +203,11 @@ class AsyncReplicaServer:
         self._peer_links: Dict[int, _PeerLink] = {}
         self._peer_locks: Dict[int, asyncio.Lock] = {}
         self._batch_wakeup = asyncio.Event()
+        # Pending seal of the primary's partial request batch (ISSUE 4):
+        # armed when the open batch first becomes non-empty, fires after
+        # config.batch_flush_us (0 = the next loop turn, which still
+        # coalesces everything already queued on the event loop).
+        self._batch_flush_handle: Optional[asyncio.TimerHandle] = None
         self._stopping = False
         self.listen_port = 0
         self.batches_run = 0
@@ -250,6 +263,9 @@ class AsyncReplicaServer:
 
     async def stop(self) -> None:
         self._stopping = True
+        if self._batch_flush_handle is not None:
+            self._batch_flush_handle.cancel()
+            self._batch_flush_handle = None
         self._batch_wakeup.set()
         if self._metrics_server is not None:
             self._metrics_server.shutdown()
@@ -423,6 +439,26 @@ class AsyncReplicaServer:
             actions = self.replica.receive(msg)
         if actions:
             self._emit(actions)
+        if (
+            self.replica.open_batch_size() > 0
+            and self._batch_flush_handle is None
+        ):
+            self._batch_flush_handle = asyncio.get_running_loop().call_later(
+                self.config.batch_flush_us / 1e6, self._flush_open_batch
+            )
+        self._batch_wakeup.set()
+
+    def _flush_open_batch(self) -> None:
+        """batch_flush_us expired: seal the partial batch. A seal refused
+        by a closed watermark window keeps the batch open — re-arm so the
+        next tick retries instead of dropping the requests."""
+        self._batch_flush_handle = None
+        self._emit(self.replica.flush_open_batch())
+        if self.replica.open_batch_size() > 0 and not self._stopping:
+            self._batch_flush_handle = asyncio.get_running_loop().call_later(
+                max(self.config.batch_flush_us / 1e6, 0.001),
+                self._flush_open_batch,
+            )
         self._batch_wakeup.set()
 
     # -- the batching window -------------------------------------------------
@@ -519,6 +555,22 @@ class AsyncReplicaServer:
                     (act.msg.client, act.msg.timestamp), None
                 )
                 loop.create_task(self._dial_reply(act.client, act.msg))
+        if self.metrics_registry.enabled:
+            # Deltas of the replica's own counters: "executed" counts per
+            # REQUEST, "rounds_executed" per sequence number — together
+            # the batch amplification (requests per three-phase instance).
+            executed = self.replica.counters["executed"]
+            rounds = self.replica.counters["rounds_executed"]
+            if executed > self._seen_executed:
+                self.metrics_registry.counter(
+                    "pbft_requests_executed_total"
+                ).inc(executed - self._seen_executed)
+                self._seen_executed = executed
+            if rounds > self._seen_rounds:
+                self.metrics_registry.counter(
+                    "pbft_consensus_rounds_total"
+                ).inc(rounds - self._seen_rounds)
+                self._seen_rounds = rounds
 
     async def _open_peer_link(self, dest: int) -> Optional[_PeerLink]:
         """Dial a peer and run the link prologue: always a hello first
@@ -791,6 +843,13 @@ class AsyncReplicaServer:
 
 async def _amain(args) -> None:
     config = ClusterConfig.from_json(open(args.config).read())
+    # --batch-* override network.json (ISSUE 4), mirroring pbftd.
+    import dataclasses as _dc
+
+    if args.batch_max_items is not None and args.batch_max_items >= 1:
+        config = _dc.replace(config, batch_max_items=args.batch_max_items)
+    if args.batch_flush_us is not None and args.batch_flush_us >= 0:
+        config = _dc.replace(config, batch_flush_us=args.batch_flush_us)
     server = AsyncReplicaServer(
         config,
         args.id,
@@ -824,6 +883,21 @@ def main() -> None:
     parser.add_argument("--verifier", default="cpu")
     parser.add_argument("--vc-timeout-ms", type=int, default=0)
     parser.add_argument("--metrics-every", type=int, default=0)
+    parser.add_argument(
+        "--batch-max-items",
+        type=int,
+        default=None,
+        help="requests the primary folds into ONE three-phase instance "
+        "(overrides network.json batch_max_items; 1 = pre-batching "
+        "one-instance-per-request)",
+    )
+    parser.add_argument(
+        "--batch-flush-us",
+        type=int,
+        default=None,
+        help="how long a partial batch may wait for more requests before "
+        "the runtime seals it (overrides network.json batch_flush_us)",
+    )
     parser.add_argument(
         "--metrics-port",
         type=int,

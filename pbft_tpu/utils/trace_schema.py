@@ -93,6 +93,14 @@ METRIC_SCHEMAS = {
     "pbft_codec_binary_frames_total": ("counter", {"server.py", "net.cc"}),
     "pbft_codec_json_frames_total": ("counter", {"server.py", "net.cc"}),
     "pbft_broadcast_encodes_total": ("counter", {"server.py", "net.cc"}),
+    # Batching surface (ISSUE 4): requests executed vs three-phase
+    # instances executed (their ratio is the batch amplification), and
+    # the per-accepted-pre-prepare batch occupancy histogram. Note
+    # pbft_executed_total counts per SEQUENCE (span closes), so it tracks
+    # pbft_consensus_rounds_total, not requests.
+    "pbft_requests_executed_total": ("counter", {"server.py", "net.cc"}),
+    "pbft_consensus_rounds_total": ("counter", {"server.py", "net.cc"}),
+    "pbft_batch_size": ("histogram", {"server.py", "net.cc"}),
     "pbft_verify_batch_size": ("histogram", {"server.py", "service.py", "net.cc"}),
     "pbft_verify_seconds": ("histogram", {"server.py", "service.py", "net.cc"}),
     "pbft_phase_pre_prepare_seconds": ("histogram", {"server.py", "net.cc"}),
@@ -128,6 +136,10 @@ def histogram_buckets(name: str):
     """The fixed bucket edges for a manifest histogram."""
     if METRIC_SCHEMAS[name][0] != "histogram":
         raise ValueError(f"{name} is not a histogram")
-    if name in ("pbft_verify_batch_size", "pbft_verify_pool_window_size"):
+    if name in (
+        "pbft_verify_batch_size",
+        "pbft_verify_pool_window_size",
+        "pbft_batch_size",
+    ):
         return BATCH_SIZE_BUCKETS
     return LATENCY_BUCKETS_S

@@ -1,5 +1,6 @@
 #!/usr/bin/env python
-"""Occupancy sweep: pipeline depth x flush window -> items/launch.
+"""Occupancy sweep: pipeline depth x flush window -> items/launch, and
+(batch arm) request-batch size x verify window -> requests/sec.
 
 Runs the f=1 firehose config through the coalescing VerifierService
 (native C++ backend — no chip needed; occupancy is a property of the
@@ -10,8 +11,16 @@ on-host launch cost. This is the committed evidence behind BASELINE.md's
 claim that the f=1 batching window scales with load and the knob — not a
 single lucky run.
 
+The BATCH arm (--batches, ISSUE 4) sweeps the two batching knobs
+together: batch_max_items (requests per three-phase instance) x the
+verify flush window — per cell it reports requests/sec, rounds/sec, and
+the measured mean batch occupancy, so the pair can be tuned jointly
+(fatter request batches mean fewer-but-larger verifier items per round,
+which shifts the optimal verify window).
+
 Usage: python scripts/window_sweep.py [--out benchmarks/window_sweep.jsonl]
        [--pipelines 8,16,32,64] [--flushes 0,1000,2000] [--requests 192]
+       [--batches 1,8,32] (enables the batch arm)
 """
 
 from __future__ import annotations
@@ -60,6 +69,35 @@ def run_cell(pipeline: int, flush_us: int, requests: int, kernel_rate: float):
     }
 
 
+def run_batch_cell(
+    batch_max_items: int, flush_us: int, requests: int, pipeline: int
+):
+    """One batch-arm cell: real pbftd daemons (in-process cpu verifier),
+    batch_max_items x verify_flush window, reporting the request-rate
+    side of the trade instead of verifier occupancy."""
+    from pbft_tpu.bench.harness import run_native_config
+
+    res = run_native_config(
+        1,  # firehose f=1
+        requests=requests,
+        pipeline=pipeline,
+        flush_us=flush_us,
+        batch_max_items=batch_max_items,
+        batch_flush_us=min(2000, max(500, flush_us)) if batch_max_items > 1 else 0,
+    )
+    return {
+        "config": "firehose f=1",
+        "arm": "batch",
+        "batch_max_items": batch_max_items,
+        "flush_us": flush_us,
+        "pipeline": pipeline,
+        "requests": res.requests,
+        "requests_per_sec": res.requests_per_sec,
+        "rounds_per_sec": res.rounds_per_sec,
+        "mean_batch": res.mean_batch,
+    }
+
+
 def main() -> None:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--out", default=None)
@@ -67,19 +105,43 @@ def main() -> None:
     parser.add_argument("--flushes", default="0,1000,2000")
     parser.add_argument("--requests", type=int, default=192)
     parser.add_argument(
+        "--batches",
+        default=None,
+        help="comma list of batch_max_items values; selects the BATCH arm "
+        "(batch size x verify window -> requests/sec) instead of the "
+        "pipeline-occupancy arm",
+    )
+    parser.add_argument(
+        "--pipeline",
+        type=int,
+        default=64,
+        help="in-flight requests for the batch arm's load generator",
+    )
+    parser.add_argument(
         "--kernel",
         default=os.path.join(REPO, "benchmarks", "tpu_r3_kernel_builder.json"),
         help="committed kernel measurement for the projection column",
     )
     args = parser.parse_args()
-    kernel_rate = float(json.loads(pathlib.Path(args.kernel).read_text())["value"])
 
     rows = []
-    for pipeline in [int(x) for x in args.pipelines.split(",")]:
-        for flush_us in [int(x) for x in args.flushes.split(",")]:
-            row = run_cell(pipeline, flush_us, args.requests, kernel_rate)
-            print(json.dumps(row), flush=True)
-            rows.append(row)
+    if args.batches:
+        for batch in [int(x) for x in args.batches.split(",")]:
+            for flush_us in [int(x) for x in args.flushes.split(",")]:
+                row = run_batch_cell(
+                    batch, flush_us, args.requests, args.pipeline
+                )
+                print(json.dumps(row), flush=True)
+                rows.append(row)
+    else:
+        kernel_rate = float(
+            json.loads(pathlib.Path(args.kernel).read_text())["value"]
+        )
+        for pipeline in [int(x) for x in args.pipelines.split(",")]:
+            for flush_us in [int(x) for x in args.flushes.split(",")]:
+                row = run_cell(pipeline, flush_us, args.requests, kernel_rate)
+                print(json.dumps(row), flush=True)
+                rows.append(row)
     if args.out:
         with open(args.out, "w") as fh:
             for row in rows:

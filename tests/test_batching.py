@@ -1,0 +1,244 @@
+"""Batched agreement semantics (ISSUE 4): one three-phase instance per
+request batch.
+
+Covers the batch state machine end to end in the deterministic core:
+duplicate suppression against the OPEN (unsealed) batch, in-batch
+execution order with per-client exactly-once + cached replies, the
+runtime flush path, empty-batch digests, and a batched pre-prepare
+surviving a view change with prepared proofs.
+"""
+
+import dataclasses
+
+from pbft_tpu.consensus.config import make_local_cluster
+from pbft_tpu.consensus.messages import (
+    ClientRequest,
+    PrePrepare,
+    batch_digest,
+    blake2b_256,
+)
+from pbft_tpu.consensus.replica import Broadcast, Replica
+from pbft_tpu.consensus.simulation import Cluster
+
+
+def _batched_cluster(n=4, batch=4, flush_us=0):
+    config, seeds = make_local_cluster(n)
+    config = dataclasses.replace(
+        config, batch_max_items=batch, batch_flush_us=flush_us
+    )
+    return Cluster(config=config, seeds=seeds)
+
+
+# -- batch digest -------------------------------------------------------------
+
+
+def test_batch_digest_definition():
+    a = ClientRequest(operation="a", timestamp=1, client="c:1")
+    b = ClientRequest(operation="b", timestamp=2, client="c:2")
+    # Batch of one keeps the LEGACY definition (wire compat with 1.1.0).
+    assert batch_digest((a,)) == a.digest()
+    # Other sizes: Blake2b over the concatenated per-request digests.
+    want = blake2b_256(
+        bytes.fromhex(a.digest()) + bytes.fromhex(b.digest())
+    ).hex()
+    assert batch_digest((a, b)) == want
+    assert batch_digest(()) == blake2b_256(b"").hex()
+    # Order-sensitive: agreement is on an ORDERED batch.
+    assert batch_digest((a, b)) != batch_digest((b, a))
+
+
+def test_batch_of_one_wire_identical_to_legacy():
+    """A sealed batch of one must produce the exact legacy pre-prepare
+    encoding — singular `request` member, legacy digest — so a
+    batch_max_items=1 cluster interoperates with pre-batching peers."""
+    config, seeds = make_local_cluster(4)
+    r = Replica(config, 0, seeds[0])
+    req = ClientRequest(operation="solo", timestamp=1, client="c:1")
+    [bcast] = [a for a in r.on_client_request(req) if isinstance(a, Broadcast)]
+    pp = bcast.msg
+    assert isinstance(pp, PrePrepare)
+    assert pp.digest == req.digest()
+    d = pp.to_dict()
+    assert "request" in d and "requests" not in d
+
+
+# -- open-batch duplicate suppression ----------------------------------------
+
+
+def test_duplicate_in_open_batch_suppressed():
+    """A retransmission arriving while its first copy sits in the open
+    (unsealed) batch must not claim a second batch slot."""
+    c = _batched_cluster(batch=4)
+    r0 = c.replicas[0]
+    c.submit("pay", client="c:9", timestamp=5)
+    c.run()
+    assert r0.open_batch_size() == 1
+    c.submit("pay", client="c:9", timestamp=5)  # exact retransmission
+    c.run()
+    assert r0.open_batch_size() == 1  # no second slot
+    assert r0.counters["duplicate_requests"] >= 1
+    # A NEWER request from the same client does take a slot.
+    c.submit("pay-again", client="c:9", timestamp=6)
+    c.run()
+    assert r0.open_batch_size() == 2
+
+
+def test_flush_open_batch_seals_partial():
+    """The runtime's batch_flush_us timer path: a partial batch seals on
+    flush_open_batch and the requests commit as one instance."""
+    c = _batched_cluster(batch=64)
+    reqs = [c.submit(f"op-{i}", client=f"c:{i}") for i in range(3)]
+    c.run()
+    r0 = c.replicas[0]
+    assert r0.open_batch_size() == 3  # far below batch_max_items
+    assert all(r.executed_upto == 0 for r in c.replicas)
+    c._emit(0, r0.flush_open_batch())
+    c.run()
+    assert r0.open_batch_size() == 0
+    for req in reqs:
+        assert c.committed_result(req.timestamp) == "awesome!"
+    for r in c.replicas:
+        assert r.executed_upto == 1  # ONE sequence number for the batch
+        assert r.counters["rounds_executed"] == 1
+        assert r.counters["executed"] == 3
+    assert len({r.state_digest for r in c.replicas}) == 1
+
+
+# -- in-batch execution semantics --------------------------------------------
+
+
+def test_batch_executes_in_order_one_reply_per_request():
+    c = _batched_cluster(batch=4)
+    reqs = [c.submit(f"op-{i}", client=f"c:{i}") for i in range(4)]
+    c.run()  # 4th request seals the batch; one instance commits all four
+    for req in reqs:
+        assert c.committed_result(req.timestamp) == "awesome!"
+    for r in c.replicas:
+        assert r.executed_upto == 1
+        assert r.counters["rounds_executed"] == 1
+        assert r.counters["executed"] == 4
+    assert len({r.state_digest for r in c.replicas}) == 1
+    # Replies preserve batch order per replica (primary replies first in
+    # the simulation's emit order; each replica replied once per request).
+    assert len(c.client_replies) == 4 * 4
+
+
+def test_same_client_twice_in_one_batch_exactly_once():
+    """Two requests from ONE client (increasing timestamps) may share a
+    batch: both execute, in order, and the reply cache ends at the later
+    timestamp."""
+    c = _batched_cluster(batch=3)
+    c.submit("first", client="c:x", timestamp=1)
+    c.submit("second", client="c:x", timestamp=2)
+    c.submit("other", client="c:y", timestamp=1)  # seals at 3
+    c.run()
+    for r in c.replicas:
+        assert r.counters["executed"] == 3
+        assert r.last_timestamp["c:x"] == 2
+        assert r.last_reply["c:x"].timestamp == 2
+    # Retransmit the EARLIER one: duplicate — it takes NO batch slot, so
+    # the next batch seals on three genuinely new requests.
+    c.submit("first", client="c:x", timestamp=1)
+    c.submit("n1", client="c:a", timestamp=1)
+    c.submit("n2", client="c:b", timestamp=1)
+    c.run()
+    assert c.replicas[0].open_batch_size() == 2  # duplicate claimed no slot
+    c.submit("n3", client="c:c", timestamp=1)  # seals at 3
+    c.run()
+    for r in c.replicas:
+        assert r.counters["executed"] == 6  # only the three new ones
+
+
+def test_cached_reply_resent_for_executed_batch_member():
+    c = _batched_cluster(batch=2)
+    c.submit("pay", client="c:m", timestamp=3)
+    c.submit("other", client="c:n", timestamp=1)  # seals
+    c.run()
+    before = len(c.replies_for(3))
+    assert before >= 1
+    c.submit("pay", client="c:m", timestamp=3)  # retransmission post-exec
+    c.run()
+    assert len(c.replies_for(3)) == before + 1  # cached reply, no re-exec
+    assert all(r.counters["executed"] == 2 for r in c.replicas)
+
+
+# -- view change with batches -------------------------------------------------
+
+
+def test_batched_pre_prepare_survives_view_change():
+    """A PREPARED (uncommitted) batch must be re-issued whole in the new
+    view via the prepared proofs and execute exactly once per request
+    (PBFT §4.4 safety, at batch granularity)."""
+    c = _batched_cluster(batch=3)
+    c.outbound_mutator = lambda src, msg: (
+        None if type(msg).__name__ == "Commit" else msg
+    )
+    reqs = [c.submit(f"op-{i}", client=f"c:{i}") for i in range(3)]
+    c.run(max_steps=500)
+    assert all(r.executed_upto == 0 for r in c.replicas)
+    prepared_somewhere = [r.id for r in c.replicas if r._prepared((0, 1))]
+    assert prepared_somewhere, "the batch must have prepared somewhere"
+    c.outbound_mutator = None
+    c.crash(0)
+    c.trigger_view_change([1, 2, 3])
+    c.run(max_steps=500)
+    live = [c.replicas[i] for i in (1, 2, 3)]
+    assert all(r.view == 1 for r in live)
+    for req in reqs:
+        assert c.committed_result(req.timestamp) == "awesome!"
+    for r in live:
+        assert r.counters["executed"] == 3  # whole batch, exactly once
+        assert r.counters["rounds_executed"] == 1
+    assert len({r.state_digest for r in live}) == 1
+
+
+def test_new_view_gap_filler_is_empty_batch():
+    """Sequence gaps in a new view are filled with EMPTY batches whose
+    execution is a no-op but still advances the chain — and the chain
+    fold matches the legacy null request's, so the encodings agree."""
+    config, seeds = make_local_cluster(4)
+    config = dataclasses.replace(config, batch_max_items=1)
+    replicas = [Replica(config, i, seeds[i]) for i in range(4)]
+    # Replica 2 prepares seq 2 in view 0 but seq 1 never prepares
+    # anywhere: the new primary must null-fill seq 1.
+    primary = replicas[0]
+    primary.on_client_request(
+        ClientRequest(operation="gap", timestamp=1, client="c:1")
+    )
+    [pp2_b] = [
+        a
+        for a in primary.on_client_request(
+            ClientRequest(operation="kept", timestamp=1, client="c:2")
+        )
+        if isinstance(a, Broadcast)
+    ]
+    pp2 = pp2_b.msg
+    from pbft_tpu.consensus.messages import Prepare
+
+    backup = replicas[2]
+    backup._dispatch(pp2)
+    other = replicas[3]
+    backup._dispatch(
+        other._sign(Prepare(view=0, seq=2, digest=pp2.digest, replica=3))
+    )
+    assert backup._prepared((0, 2))
+    # View change to view 1 (primary 1) with 2f+1 = 3 participants.
+    acts = []
+    for rid in (1, 2, 3):
+        acts.append((rid, replicas[rid].start_view_change()))
+    # Deliver all view-changes to the new primary.
+    for rid, alist in acts:
+        for a in alist:
+            if isinstance(a, Broadcast):
+                for dst in (1, 2, 3):
+                    if dst != rid:
+                        replicas[dst]._dispatch(a.msg)
+    nv_pps = [
+        pp
+        for (v, s), pp in replicas[1].pre_prepares.items()
+        if v == 1
+    ]
+    by_seq = {pp.seq: pp for pp in nv_pps}
+    assert by_seq[1].requests == ()  # the gap: an EMPTY batch
+    assert by_seq[1].digest == batch_digest(())
+    assert [r.operation for r in by_seq[2].requests] == ["kept"]

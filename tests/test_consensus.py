@@ -32,7 +32,7 @@ def test_wire_roundtrip():
     req = mk_request()
     for msg in [
         req,
-        PrePrepare(view=0, seq=1, digest=req.digest(), request=req, replica=0, sig="ab"),
+        PrePrepare(view=0, seq=1, digest=req.digest(), requests=(req,), replica=0, sig="ab"),
         Prepare(view=0, seq=1, digest="d", replica=2, sig="cd"),
         Commit(view=0, seq=1, digest="d", replica=3, sig="ef"),
         Checkpoint(seq=16, digest="s", replica=1, sig="01"),
@@ -115,7 +115,7 @@ def test_conflicting_pre_prepare_rejected():
     # Equivocation: same (v, n), different digest.
     req2 = mk_request(op="second", t=2)
     evil = primary._sign(
-        PrePrepare(view=0, seq=1, digest=req2.digest(), request=req2, replica=0)
+        PrePrepare(view=0, seq=1, digest=req2.digest(), requests=(req2,), replica=0)
     )
     assert r._dispatch(evil) == []
     assert r.pre_prepares[(0, 1)].digest == pp_bcast.msg.digest
@@ -126,7 +126,7 @@ def test_pre_prepare_from_non_primary_rejected():
     backup = Replica(config, 1, seeds[1])
     req = mk_request()
     fake = backup._sign(
-        PrePrepare(view=0, seq=1, digest=req.digest(), request=req, replica=1)
+        PrePrepare(view=0, seq=1, digest=req.digest(), requests=(req,), replica=1)
     )
     assert r._dispatch(fake) == []
     assert (0, 1) not in r.pre_prepares
@@ -141,7 +141,7 @@ def test_watermark_rejects_out_of_window():
             view=0,
             seq=config.watermark_window + 1,
             digest=req.digest(),
-            request=req,
+            requests=(req,),
             replica=0,
         )
     )

@@ -377,6 +377,66 @@ def test_byzantine_primary_voted_out_over_secure_links():
             client.close()
 
 
+def test_mixed_batched_and_batch1_cluster_commits():
+    """ISSUE 4 acceptance: a cluster whose primary batches (pbftd,
+    batch_max_items=8) while every backup runs batch_max_items=1 — and
+    half the replicas are the asyncio runtime — commits a pipelined
+    request stream. Batch composition is the primary's choice; acceptance
+    is size-agnostic, so the mix must be invisible to correctness. The
+    metrics tail proves real batching happened: fewer three-phase
+    instances than requests executed."""
+    import json as _json
+    import re
+    import time
+    from pathlib import Path
+
+    with LocalCluster(
+        n=4,
+        verifier="cpu",
+        impl=["cxx", "py", "cxx", "py"],
+        metrics_every=1,
+        batch_max_items=[8, 1, 1, 1],
+        batch_flush_us=[50000, 0, 0, 0],
+    ) as cluster:
+        client = PbftClient(cluster.config)
+        try:
+            results = client.request_many(
+                [f"batched-{i}" for i in range(12)], window=8, timeout=30
+            )
+            assert results == ["awesome!"] * 12
+            time.sleep(1.6)  # one more metrics tick
+            # Replica 1 (an asyncio batch=1 BACKUP) accepted and executed
+            # the primary's batches: requests executed must exceed
+            # consensus rounds, or no batch ever formed.
+            log = (Path(cluster.tmpdir.name) / "replica-1.log").read_text(
+                errors="ignore"
+            )
+            executed = re.findall(r'"executed":\s*(\d+)', log)
+            rounds = re.findall(r'"rounds_executed":\s*(\d+)', log)
+            assert executed and rounds, log[-1500:]
+            assert int(executed[-1]) == 12
+            assert int(rounds[-1]) < int(executed[-1]), (
+                f"no batching observed: rounds={rounds[-1]} "
+                f"executed={executed[-1]}"
+            )
+        finally:
+            client.close()
+
+
+def test_pipelined_request_many_single_connection():
+    """PbftClient.request_many streams a window over ONE connection and
+    completes in submission order — the load shape that fills batches."""
+    with LocalCluster(n=4, verifier="cpu") as cluster:
+        client = PbftClient(cluster.config)
+        try:
+            results = client.request_many(
+                [f"win-{i}" for i in range(9)], window=4, timeout=30
+            )
+            assert results == ["awesome!"] * 9
+        finally:
+            client.close()
+
+
 @pytest.mark.parametrize("impl", ["cxx", "py"])
 def test_bounded_accumulation_window_commits(impl):
     """verify_flush_us holds each replica's verify queue briefly so one

@@ -38,7 +38,17 @@ REQ = ClientRequest(
     operation='héllo ☃ "q" \\s\n\t\x01 \U0001f600', timestamp=1 << 40,
     client="127.0.0.1:9000",
 )
-_PP = PrePrepare(view=0, seq=17, digest=REQ.digest(), request=REQ, replica=0, sig="ab" * 64)
+_PP = PrePrepare(view=0, seq=17, digest=REQ.digest(), requests=(REQ,), replica=0, sig="ab" * 64)
+REQ2 = ClientRequest(operation="op-2", timestamp=2, client="127.0.0.1:9001")
+from pbft_tpu.consensus.messages import batch_digest
+_PP_BATCH = PrePrepare(
+    view=0, seq=18, digest=batch_digest((REQ, REQ2)), requests=(REQ, REQ2),
+    replica=0, sig="ab" * 64,
+)
+_PP_EMPTY = PrePrepare(
+    view=1, seq=19, digest=batch_digest(()), requests=(), replica=1,
+    sig="cd" * 64,
+)
 _PREP = Prepare(view=0, seq=17, digest=REQ.digest(), replica=2, sig="cd" * 64)
 _CP = Checkpoint(seq=16, digest="11" * 32, replica=1, sig="22" * 64)
 _VC = ViewChange(
@@ -54,7 +64,9 @@ _VC = ViewChange(
 MESSAGES = [
     REQ,
     ClientReply(view=0, timestamp=1, client="c:1", replica=3, result="awesome!"),
-    PrePrepare(view=0, seq=7, digest=REQ.digest(), request=REQ, replica=0, sig="ab" * 64),
+    PrePrepare(view=0, seq=7, digest=REQ.digest(), requests=(REQ,), replica=0, sig="ab" * 64),
+    _PP_BATCH,  # batched pre-prepare (ISSUE 4): `requests` list form
+    _PP_EMPTY,  # empty batch: the batched new-view gap filler
     Prepare(view=1, seq=2, digest="dd" * 32, replica=2, sig="cd" * 64),
     Commit(view=1, seq=2, digest="dd" * 32, replica=2, sig="ef" * 64),
     Checkpoint(seq=16, digest="11" * 32, replica=1, sig="22" * 64),

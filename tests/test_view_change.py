@@ -97,7 +97,7 @@ def test_new_view_with_forged_o_rejected():
     # NEW-VIEW that injects a pre-prepare for an invented request.
     evil_req = null_request()
     forged_pp = replicas[1]._sign(
-        PrePrepare(view=1, seq=1, digest=evil_req.digest(), request=evil_req, replica=1)
+        PrePrepare(view=1, seq=1, digest=evil_req.digest(), requests=(evil_req,), replica=1)
     )
     forged = replicas[1]._sign(
         NewView(

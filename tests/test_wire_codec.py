@@ -65,7 +65,9 @@ def _rand_request(rng):
 
 
 def _rand_hot(rng):
-    """One randomized message of each binary-v2 type."""
+    """One randomized message of each binary-v2 type — pre-prepares in
+    both the legacy batch-of-one layout (0x02) and the batched layout
+    (0x06; sizes 0 and 2-5, size 1 must never take this form)."""
     req = _rand_request(rng)
     return [
         req,
@@ -73,7 +75,18 @@ def _rand_hot(rng):
             view=_rand_i64(rng),
             seq=_rand_i64(rng),
             digest=_rand_hex(rng, 32),
-            request=_rand_request(rng),
+            requests=(_rand_request(rng),),
+            replica=_rand_i64(rng),
+            sig=_rand_hex(rng, 64),
+        ),
+        M.PrePrepare(
+            view=_rand_i64(rng),
+            seq=_rand_i64(rng),
+            digest=_rand_hex(rng, 32),
+            requests=tuple(
+                _rand_request(rng)
+                for _ in range(rng.choice([0, 2, 3, 4, 5]))
+            ),
             replica=_rand_i64(rng),
             sig=_rand_hex(rng, 64),
         ),
@@ -105,7 +118,8 @@ def _every_type():
     req = M.ClientRequest(operation="op", timestamp=3, client="127.0.0.1:9000")
     cp = M.Checkpoint(seq=16, digest="ab" * 32, replica=1, sig="cd" * 64)
     pp = M.PrePrepare(
-        view=0, seq=1, digest=req.digest(), request=req, replica=0, sig="ee" * 64
+        view=0, seq=1, digest=req.digest(), requests=(req,), replica=0,
+        sig="ee" * 64,
     )
     prep = M.Prepare(view=0, seq=1, digest=req.digest(), replica=2, sig="ff" * 64)
     return [
@@ -183,6 +197,37 @@ def test_binary_rejects_malformed():
     ):
         with pytest.raises(ValueError):
             M.from_binary(bad)
+
+
+def test_batched_pre_prepare_one_canonical_form():
+    """Each batch has ONE canonical encoding: a count==1 binary batch
+    (0x06) and a one-element JSON `requests` list are both rejected, in
+    both runtimes — two admissible encodings of the same content would
+    fork the signable digest across replicas."""
+    req = M.ClientRequest(operation="op", timestamp=3, client="c:1")
+    pp1 = M.PrePrepare(
+        view=0, seq=1, digest=req.digest(), requests=(req,), replica=0,
+        sig="ee" * 64,
+    )
+    b = M.to_binary(pp1)
+    assert b[1] == 0x02  # batch of one MUST take the legacy layout
+    # Forge the 0x06 count==1 form of the same content.
+    forged = bytes([M.WIRE_BINARY_MAGIC, 0x06]) + b[2 : 2 + 8 + 8 + 32 + 8 + 64] + (
+        (1).to_bytes(4, "big") + b[2 + 8 + 8 + 32 + 8 + 64 :]
+    )
+    with pytest.raises(ValueError):
+        M.from_binary(forged)
+    # JSON: one-element `requests` list is rejected too.
+    d = pp1.to_dict()
+    d["requests"] = [d.pop("request")]
+    with pytest.raises(ValueError):
+        M.Message.from_dict(d)
+    if HAVE_NATIVE:
+        assert native.message_from_binary(forged) is None
+        # The C++ JSON parser rejects the one-element `requests` form too
+        # (message_to_binary parses the payload first; None = rejected).
+        payload = json.dumps(d, sort_keys=True, separators=(",", ":")).encode()
+        assert native.message_to_binary(payload) is None
 
 
 @pytest.mark.skipif(not HAVE_NATIVE, reason="native core not buildable")
