@@ -29,6 +29,9 @@ const char* kCounterNames[] = {
     // Batching surface (ISSUE 4): requests executed vs three-phase
     // instances executed — their ratio is the batch amplification.
     "pbft_requests_executed_total", "pbft_consensus_rounds_total",
+    // Chaos surface (ISSUE 5): fault behaviors fired by --fault, frames
+    // dropped by the seeded --chaos-drop-pct link knob.
+    "pbft_faults_injected_total", "pbft_chaos_dropped_total",
 };
 const char* kGaugeNames[] = {
     "pbft_verify_queue_depth",

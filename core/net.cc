@@ -15,6 +15,7 @@
 #include <cstring>
 #include <type_traits>
 
+#include "ed25519.h"
 #include "verify_pool.h"
 
 namespace pbft {
@@ -68,6 +69,16 @@ int dial_socket(const std::string& host_port, bool nonblocking,
   return fd;
 }
 }  // namespace
+
+bool fault_mode_from_string(const std::string& s, FaultMode* out) {
+  if (s.empty() || s == "none") *out = FaultMode::kNone;
+  else if (s == "sig-corrupt" || s == "byzantine") *out = FaultMode::kSigCorrupt;
+  else if (s == "mute") *out = FaultMode::kMute;
+  else if (s == "stutter") *out = FaultMode::kStutter;
+  else if (s == "equivocate") *out = FaultMode::kEquivocate;
+  else return false;
+  return true;
+}
 
 int dial_tcp(const std::string& host_port) {
   return dial_socket(host_port, /*nonblocking=*/false, nullptr);
@@ -182,6 +193,21 @@ void ReplicaServer::poll_once(int timeout_ms) {
                    .count();
     timeout_ms = std::min<int64_t>(timeout_ms, std::max<int64_t>(rem, 0) + 1);
   }
+  if (!chaos_queue_.empty()) {
+    // Held (chaos-delayed) frames release on a deadline; a quiet socket
+    // set must not stretch the injected delay past what was drawn.
+    auto earliest = std::chrono::steady_clock::time_point::max();
+    for (const auto& [_, q] : chaos_queue_) {
+      if (!q.empty()) earliest = std::min(earliest, q.front().first);
+    }
+    if (earliest != std::chrono::steady_clock::time_point::max()) {
+      auto rem = std::chrono::duration_cast<std::chrono::milliseconds>(
+                     earliest - std::chrono::steady_clock::now())
+                     .count();
+      timeout_ms =
+          std::min<int64_t>(timeout_ms, std::max<int64_t>(rem, 0) + 1);
+    }
+  }
   std::vector<pollfd> pfds;
   pfds.push_back({listen_fd_, POLLIN, 0});
   std::vector<Conn*> order;
@@ -259,6 +285,7 @@ void ReplicaServer::poll_once(int timeout_ms) {
   // verifier this immediately dispatches the window that accumulated
   // during the launch that just completed.
   run_verify_batch();
+  pump_chaos_queue(std::chrono::steady_clock::now());  // release held frames
   pump_reply_backlog();  // launch queued reply dials as slots free
   check_progress_timer();
   if (discovery_) {
@@ -885,8 +912,52 @@ Message corrupt_sig(Message m) {
 }
 }  // namespace
 
+void ReplicaServer::count_fault() {
+  ++faults_injected_;
+  metrics_.inc("pbft_faults_injected_total");
+}
+
+Message ReplicaServer::equivocate_variant(const PrePrepare& pp) {
+  PrePrepare b = pp;
+  for (auto& r : b.requests) r.operation += "#equiv";
+  b.digest = b.batch_digest();
+  uint8_t digest[32], sig[64];
+  Message m(b);
+  message_signable(m, digest);
+  ed25519_sign(sig, seed_, digest, 32);
+  std::get<PrePrepare>(m).sig = to_hex(sig, 64);
+  return m;
+}
+
 void ReplicaServer::emit(Actions&& actions) {
+  const bool mute = fault_mode_ == FaultMode::kMute;
   for (auto& b : actions.broadcasts) {
+    if (mute) {  // receives but never sends (--fault mute)
+      count_fault();
+      continue;
+    }
+    if (fault_mode_ == FaultMode::kEquivocate) {
+      // The equivocating primary's own pre-prepare forks: even-numbered
+      // peers get the genuine batch, odd-numbered peers a conflicting
+      // one — SAME (view, seq), different digest, both validly signed.
+      // Neither side can reach a 2f+1 commit quorum at <= f faulty, the
+      // round stalls, and the honest replicas' timers vote us out.
+      auto* pp = std::get_if<PrePrepare>(&b.msg);
+      if (pp && pp->replica == id_ && !pp->requests.empty()) {
+        Message variant = equivocate_variant(*pp);
+        EncodedOut enc_a(&b.msg);
+        EncodedOut enc_b(&variant);
+        for (int64_t dest = 0; dest < cfg_.n(); ++dest) {
+          if (dest != id_) send_encoded(dest, dest % 2 == 0 ? enc_a : enc_b);
+        }
+        count_fault();
+        ++broadcasts_;
+        broadcast_encodes_ += enc_a.encodes + enc_b.encodes;
+        metrics_.inc("pbft_broadcast_encodes_total",
+                     enc_a.encodes + enc_b.encodes);
+        continue;
+      }
+    }
     // Serialize-once fan-out: ONE canonical encode (and at most one
     // binary-v2 encode, when any link negotiated it) per broadcast,
     // shared across every destination — the per-peer loop is pick codec,
@@ -894,9 +965,10 @@ void ReplicaServer::emit(Actions&& actions) {
     // applied once too: every peer sees the same garbage signature.
     Message corrupted;
     const Message* mp = &b.msg;
-    if (byzantine_) {
+    if (fault_mode_ == FaultMode::kSigCorrupt) {
       corrupted = corrupt_sig(b.msg);
       mp = &corrupted;
+      count_fault();
     }
     EncodedOut enc(mp);
     for (int64_t dest = 0; dest < cfg_.n(); ++dest) {
@@ -905,6 +977,28 @@ void ReplicaServer::emit(Actions&& actions) {
     ++broadcasts_;
     broadcast_encodes_ += enc.encodes;
     metrics_.inc("pbft_broadcast_encodes_total", enc.encodes);
+    if (fault_mode_ == FaultMode::kStutter) {
+      // Seeded stale replays: rebroadcast an old (validly signed)
+      // message alongside the fresh one. Honest replicas must treat the
+      // replay as the duplicate it is.
+      if (!stutter_history_.empty() &&
+          std::uniform_real_distribution<double>()(chaos_rng_) < 0.3) {
+        size_t pick = (size_t)(std::uniform_real_distribution<double>()(
+                                   chaos_rng_) *
+                               stutter_history_.size());
+        if (pick >= stutter_history_.size()) pick = 0;
+        EncodedOut stale(&stutter_history_[pick]);
+        for (int64_t dest = 0; dest < cfg_.n(); ++dest) {
+          if (dest != id_) send_encoded(dest, stale);
+        }
+        count_fault();
+        ++broadcasts_;
+        broadcast_encodes_ += stale.encodes;
+        metrics_.inc("pbft_broadcast_encodes_total", stale.encodes);
+      }
+      stutter_history_.push_back(b.msg);
+      if (stutter_history_.size() > 32) stutter_history_.pop_front();
+    }
   }
   for (auto& s : actions.sends) {
     // A ClientRequest forwarded to the primary starts this replica's
@@ -921,6 +1015,10 @@ void ReplicaServer::emit(Actions&& actions) {
   }
   for (auto& r : actions.replies) {
     waiting_requests_.erase({r.msg.client, r.msg.timestamp});
+    if (mute) {  // a mute replica never dials the client back either
+      count_fault();
+      continue;
+    }
     dial_reply(r.client, r.msg);
   }
   observe_execution_metrics();
@@ -1044,22 +1142,35 @@ int ReplicaServer::peer_fd(int64_t dest) {
 
 void ReplicaServer::send_to(int64_t dest, const Message& m) {
   if (dest == id_) {
-    // Self-delivery bypasses the wire AND the corruption: a Byzantine
-    // signer trusts its own messages; only its peers see garbage.
+    // Self-delivery bypasses the wire AND the fault modes: a Byzantine
+    // replica trusts its own messages; only its peers see the behavior.
     emit(replica_->receive(m));
+    return;
+  }
+  if (fault_mode_ == FaultMode::kMute) {
+    count_fault();
     return;
   }
   Message corrupted;
   const Message* mp = &m;
-  if (byzantine_) {
+  if (fault_mode_ == FaultMode::kSigCorrupt) {
     corrupted = corrupt_sig(m);
     mp = &corrupted;
+    count_fault();
   }
   EncodedOut enc(mp);
   send_encoded(dest, enc);
 }
 
 void ReplicaServer::send_encoded(int64_t dest, EncodedOut& enc) {
+  if (chaos_drop_pct_ > 0 &&
+      std::uniform_real_distribution<double>()(chaos_rng_) < chaos_drop_pct_) {
+    // Seeded link loss (--chaos-drop-pct): the frame never leaves this
+    // replica. PBFT's retransmission paths must absorb it.
+    ++chaos_dropped_;
+    metrics_.inc("pbft_chaos_dropped_total");
+    return;
+  }
   if (peer_fd(dest) < 0) return;  // peer down: PBFT tolerates f of these
   Conn& c = *peers_[dest];
   const std::string* payload = nullptr;
@@ -1078,11 +1189,51 @@ void ReplicaServer::send_encoded(int64_t dest, EncodedOut& enc) {
     }
     // Per-peer sealing over the SHARED plaintext: the AEAD counter is
     // per-link state, so only the seal (not the encode) runs per peer.
-    c.wbuf += frame_payload(c.chan->seal_frame(*payload));
+    std::string framed = frame_payload(c.chan->seal_frame(*payload));
+    if (!chaos_pass(dest, framed)) return;
+    c.wbuf += framed;
   } else {
-    c.wbuf += frame_payload(*payload);
+    std::string framed = frame_payload(*payload);
+    if (!chaos_pass(dest, framed)) return;
+    c.wbuf += framed;
   }
   flush(c);
+}
+
+bool ReplicaServer::chaos_pass(int64_t dest, const std::string& framed) {
+  if (chaos_delay_ms_ <= 0) return true;
+  // Per-destination FIFO: frames release in the order they were sealed,
+  // so the delay reorders ACROSS links (and against local processing) but
+  // never within one link — a secure channel's AEAD nonces stay in
+  // sequence. The release jitter is drawn from the seeded chaos RNG.
+  int jitter = (int)(std::uniform_real_distribution<double>()(chaos_rng_) *
+                     (double)chaos_delay_ms_);
+  chaos_queue_[dest].push_back(
+      {std::chrono::steady_clock::now() + std::chrono::milliseconds(jitter),
+       framed});
+  return false;
+}
+
+void ReplicaServer::pump_chaos_queue(
+    std::chrono::steady_clock::time_point now) {
+  if (chaos_queue_.empty()) return;
+  for (auto it = chaos_queue_.begin(); it != chaos_queue_.end();) {
+    auto& q = it->second;
+    while (!q.empty() && q.front().first <= now) {
+      auto p = peers_.find(it->first);
+      if (p != peers_.end() && !p->second->closed &&
+          !p->second->connecting) {
+        p->second->wbuf += q.front().second;
+        flush(*p->second);
+      } else {
+        // Link died while the frame was held: the delay became a drop.
+        ++chaos_dropped_;
+        metrics_.inc("pbft_chaos_dropped_total");
+      }
+      q.pop_front();
+    }
+    it = q.empty() ? chaos_queue_.erase(it) : std::next(it);
+  }
 }
 
 void ReplicaServer::dial_reply(const std::string& client_addr,
@@ -1097,7 +1248,10 @@ void ReplicaServer::dial_reply(const std::string& client_addr,
   // replies included, matching the simulation mutator (bench/harness.py)
   // and net.h's contract: this replica's reply vote must not count at the
   // client's f+1 signature-verified quorum.
-  if (byzantine_ && !out.sig.empty()) out.sig.assign(out.sig.size(), 'f');
+  if (fault_mode_ == FaultMode::kSigCorrupt && !out.sig.empty()) {
+    out.sig.assign(out.sig.size(), 'f');
+    count_fault();
+  }
   start_reply_dial(client_addr, out.to_json().dump() + "\n");
 }
 
@@ -1195,6 +1349,8 @@ std::string ReplicaServer::metrics_json() const {
   o["broadcast_encodes"] = Json(broadcast_encodes_);
   o["reply_backlog"] = Json((int64_t)reply_backlog_.size());
   o["replies_dropped"] = Json(replies_dropped_);
+  o["faults_injected"] = Json(faults_injected_);
+  o["chaos_dropped"] = Json(chaos_dropped_);
   o["verify_deadline_fired"] = Json(verify_deadline_fired_);
   o["executed_upto"] = Json(replica_->executed_upto());
   o["low_mark"] = Json(replica_->low_mark());

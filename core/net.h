@@ -22,6 +22,7 @@
 #include <deque>
 #include <map>
 #include <memory>
+#include <random>
 #include <set>
 #include <string>
 #include <utility>
@@ -98,6 +99,20 @@ struct EncodedOut {
   }
 };
 
+// Replica-level Byzantine behavior modes (--fault, ISSUE 5). Mirrors the
+// simulation's FAULT_MODES and the asyncio runtime's --fault so a chaos
+// scenario scripts identically against either daemon:
+//   kSigCorrupt — every outgoing signature corrupted (the old --byzantine);
+//   kMute       — receives but never sends (protocol frames AND replies);
+//   kStutter    — sends normally, plus seeded replays of stale messages;
+//   kEquivocate — the primary sends CONFLICTING validly-signed
+//                 pre-prepares for one (view, seq) to different backups.
+enum class FaultMode { kNone, kSigCorrupt, kMute, kStutter, kEquivocate };
+
+// "" / "none" -> kNone, "sig-corrupt"/"byzantine" -> kSigCorrupt, etc.
+// Returns false on an unknown mode name.
+bool fault_mode_from_string(const std::string& s, FaultMode* out);
+
 class ReplicaServer {
  public:
   ReplicaServer(ClusterConfig cfg, int64_t id, const uint8_t seed[32],
@@ -154,11 +169,26 @@ class ReplicaServer {
   // closes any previously set sink.
   bool set_trace_file(const std::string& path);
 
-  // Fault injection: corrupt the signature of every outgoing protocol
-  // message (the BASELINE config-5 Byzantine signer, as a real daemon
-  // instead of a simulation mutator). Honest replicas must reject the
-  // garbage signatures and commit without this replica's votes.
-  void set_byzantine(bool b) { byzantine_ = b; }
+  // Fault injection (ISSUE 5): install a Byzantine behavior mode for this
+  // daemon. set_byzantine is the legacy --byzantine spelling of the
+  // sig-corrupt mode. Honest replicas must tolerate any single mode at
+  // <= f faulty: reject what is rejectable, vote out what stalls.
+  void set_fault(FaultMode m) { fault_mode_ = m; }
+  void set_byzantine(bool b) {
+    fault_mode_ = b ? FaultMode::kSigCorrupt : FaultMode::kNone;
+  }
+
+  // Seeded link-level chaos (ISSUE 5): every outbound peer frame is
+  // dropped with probability drop_pct, and (when delay_ms > 0) held for a
+  // uniform 0..delay_ms before hitting the socket — per-destination FIFO,
+  // so secure-channel frame order (the AEAD nonce sequence) is preserved.
+  // Deterministic per (seed): the same seed replays the same drop/delay
+  // pattern for the same frame sequence.
+  void set_chaos(double drop_pct, int delay_ms, uint64_t seed) {
+    chaos_drop_pct_ = drop_pct;
+    chaos_delay_ms_ = delay_ms;
+    chaos_rng_.seed(seed);
+  }
 
  private:
   void accept_ready();
@@ -206,6 +236,18 @@ class ReplicaServer {
   int peer_fd(int64_t dest);  // cached outbound connection (lazy dial)
 
   void check_progress_timer();
+  // Chaos link gate: true when the framed bytes should be written to the
+  // peer NOW; false when they were dropped (counted) or queued for a
+  // delayed release. Called with the final on-wire frame (post-seal), so
+  // per-destination FIFO release preserves AEAD ordering.
+  bool chaos_pass(int64_t dest, const std::string& framed);
+  // Release delayed frames whose deadline arrived onto their peer links.
+  void pump_chaos_queue(std::chrono::steady_clock::time_point now);
+  // The --fault equivocate engine: variant B of the primary's own
+  // pre-prepare (operations mutated, digest recomputed, RE-SIGNED — both
+  // variants verify, which is what makes equivocation an attack).
+  Message equivocate_variant(const PrePrepare& pp);
+  void count_fault();
   // Seal the primary's partial batch once it has waited batch_flush_us
   // (ClusterConfig::batch_flush_us; 0 = seal on the next pass). poll_once
   // clamps its timeout to the flush deadline, like the verify window.
@@ -239,7 +281,21 @@ class ReplicaServer {
   std::chrono::steady_clock::time_point last_beacon_{};
   int vc_timeout_ms_ = 0;
   bool timer_armed_ = false;
-  bool byzantine_ = false;
+  FaultMode fault_mode_ = FaultMode::kNone;
+  // Chaos link state (set_chaos): seeded drop/delay on outbound peer
+  // frames, a per-destination FIFO of delayed frames, and the injected
+  // fault / dropped frame tallies surfaced in metrics_json.
+  double chaos_drop_pct_ = 0.0;
+  int chaos_delay_ms_ = 0;
+  std::mt19937_64 chaos_rng_{0xC4A05};
+  std::map<int64_t,
+           std::deque<std::pair<std::chrono::steady_clock::time_point,
+                                std::string>>>
+      chaos_queue_;
+  int64_t faults_injected_ = 0;
+  int64_t chaos_dropped_ = 0;
+  // Recently broadcast messages, for the stutter mode's stale replays.
+  std::deque<Message> stutter_history_;
   int timer_backoff_ = 1;
   std::chrono::steady_clock::time_point timer_deadline_{};
   // State-transfer retry keeps its own deadline: the view-change timer may

@@ -8,6 +8,8 @@
 //   pbftd --config network.json --id 0 --seed <64-hex>
 //         [--verifier cpu|host:port|/unix/path] [--verify-threads N]
 //         [--batch-max-items N] [--batch-flush-us US] [--metrics-every 5]
+//         [--fault sig-corrupt|mute|stutter|equivocate]
+//         [--chaos-drop-pct P] [--chaos-delay-ms N] [--chaos-seed S]
 //
 // The replica listens on its configured port for both framed peer traffic
 // and raw-JSON client connections (sniffed), verifies signature batches via
@@ -42,7 +44,12 @@ int main(int argc, char** argv) {
   int verify_threads = 0;  // 0 = hardware_concurrency (the pool default)
   int64_t batch_max_items = -1;  // -1 = keep network.json's value
   int64_t batch_flush_us = -1;
-  bool byzantine = false;
+  // Fault injection (ISSUE 5): --fault generalizes --byzantine to the
+  // full behavior-mode set; --chaos-* are seeded link-level knobs.
+  std::string fault_mode_name;
+  double chaos_drop_pct = 0.0;
+  int chaos_delay_ms = 0;
+  int64_t chaos_seed = -1;  // -1 = derive from the replica id
   for (int i = 1; i < argc; ++i) {
     std::string a = argv[i];
     auto next = [&]() -> const char* { return i + 1 < argc ? argv[++i] : ""; };
@@ -59,11 +66,22 @@ int main(int argc, char** argv) {
     else if (a == "--batch-flush-us") batch_flush_us = std::atoll(next());
     else if (a == "--discovery") discovery = next();
     else if (a == "--trace") trace_path = next();
-    else if (a == "--byzantine") byzantine = true;
+    else if (a == "--byzantine") fault_mode_name = "sig-corrupt";
+    else if (a == "--fault") fault_mode_name = next();
+    else if (a == "--chaos-drop-pct") chaos_drop_pct = std::atof(next());
+    else if (a == "--chaos-delay-ms") chaos_delay_ms = std::atoi(next());
+    else if (a == "--chaos-seed") chaos_seed = std::atoll(next());
     else {
       std::fprintf(stderr, "unknown arg: %s\n", a.c_str());
       return 2;
     }
+  }
+  pbft::FaultMode fault_mode;
+  if (!pbft::fault_mode_from_string(fault_mode_name, &fault_mode)) {
+    std::fprintf(stderr,
+                 "bad --fault %s (sig-corrupt|mute|stutter|equivocate)\n",
+                 fault_mode_name.c_str());
+    return 2;
   }
   if (config_path.empty() || id < 0 || seed_hex.size() != 64) {
     std::fprintf(stderr,
@@ -119,7 +137,13 @@ int main(int argc, char** argv) {
   // ephemeral; the bound port is logged). Metric names match the Python
   // runtime's --metrics-port (pbft_tpu/utils/trace_schema.py).
   if (metrics_port >= 0) server.set_metrics_port(metrics_port);
-  if (byzantine) server.set_byzantine(true);
+  server.set_fault(fault_mode);
+  if (chaos_drop_pct > 0 || chaos_delay_ms > 0) {
+    // Seed default: the replica id, so a cluster-wide scalar seed still
+    // gives every replica its own (reproducible) chaos stream.
+    server.set_chaos(chaos_drop_pct, chaos_delay_ms,
+                     (uint64_t)(chaos_seed >= 0 ? chaos_seed : id));
+  }
   if (!discovery.empty()) server.enable_discovery(discovery);
   if (!trace_path.empty()) server.set_trace_file(trace_path);
   if (!server.start()) {

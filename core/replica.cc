@@ -98,6 +98,15 @@ Actions Replica::on_client_request(const ClientRequest& req) {
     counters["duplicate_requests"] += 1;
     return out;
   }
+  // Already SEALED under a sequence in this view (PBFT §4.2: the primary
+  // checks its log): a retransmission arriving between seal and execution
+  // must not burn a second three-phase instance. Cleared on view entry —
+  // a request sealed in an abandoned view may need re-ordering.
+  auto sealed = sealed_ts_.find(req.client);
+  if (sealed != sealed_ts_.end() && req.timestamp <= sealed->second) {
+    counters["duplicate_requests"] += 1;
+    return out;
+  }
   open_batch_.push_back(req);
   open_batch_ts_[req.client] = req.timestamp;
   if ((int64_t)open_batch_.size() >= std::max<int64_t>(1, config_.batch_max_items)) {
@@ -116,6 +125,7 @@ Actions Replica::seal_batch() {
   std::vector<ClientRequest> batch;
   batch.swap(open_batch_);
   open_batch_ts_.clear();
+  for (const auto& req : batch) sealed_ts_[req.client] = req.timestamp;
   seq_counter_ += 1;
   if (phase_hook) phase_hook("request", view_, seq_counter_);
   PrePrepare pp;
@@ -831,12 +841,18 @@ std::pair<int64_t, std::vector<Replica::OEntry>> Replica::compute_o(
 }
 
 namespace {
-const std::string* stable_digest_for(const std::vector<ViewChange>& vcs,
-                                     int64_t min_s, int64_t f) {
+// The view-change whose checkpoint proof certifies min_s with a 2f+1
+// majority, or nullptr. Callers adopt both the digest AND the proof: a
+// replica whose watermark advances through a NEW-VIEW's min_s must also
+// adopt the certificate, or its next VIEW-CHANGE claims last_stable_seq =
+// min_s while attaching the stale pre-jump proof — which honest
+// validators reject, wedging every future view change that needs this
+// replica's vote (found by the chaos soak, mirrored in replica.py).
+const ViewChange* stable_vc_for(const std::vector<ViewChange>& vcs,
+                                int64_t min_s, int64_t f) {
   for (const auto& vc : vcs) {
     if (vc.last_stable_seq != min_s || vc.checkpoint_proof.empty()) continue;
-    const std::string* dig = majority_digest(vc.checkpoint_proof, 2 * f + 1);
-    if (dig) return dig;
+    if (majority_digest(vc.checkpoint_proof, 2 * f + 1)) return &vc;
   }
   return nullptr;
 }
@@ -874,7 +890,7 @@ Actions Replica::maybe_new_view(int64_t v) {
   new_view_sent_.insert(v);
   Actions out;
   out.broadcasts.push_back({Message(nv)});
-  out.merge(enter_new_view(v, min_s, stable_digest_for(vcs, min_s, config_.f()), pps));
+  out.merge(enter_new_view(v, min_s, stable_vc_for(vcs, min_s, config_.f()), pps));
   return out;
 }
 
@@ -913,23 +929,41 @@ Actions Replica::on_new_view(const NewView& nv) {
     if (!verify_inline(pp->replica, *m, pp->sig)) return {};
     pps.push_back(*pp);
   }
-  return enter_new_view(nv.new_view, min_s, stable_digest_for(vcs, min_s, config_.f()),
+  return enter_new_view(nv.new_view, min_s, stable_vc_for(vcs, min_s, config_.f()),
                         pps);
 }
 
 Actions Replica::enter_new_view(int64_t v, int64_t min_s,
-                                const std::string* stable_digest,
+                                const ViewChange* stable_vc,
                                 const std::vector<PrePrepare>& pps) {
   view_ = v;
   in_view_change_ = false;
   pending_view_ = 0;
+  sealed_ts_.clear();  // per-view primary ordering memory
   counters["view_changes_completed"] += 1;
   for (auto it = view_changes_.begin(); it != view_changes_.end();) {
     if (it->first <= v) it = view_changes_.erase(it);
     else ++it;
   }
   Actions out;
+  const std::string* stable_digest =
+      stable_vc ? majority_digest(stable_vc->checkpoint_proof,
+                                  2 * config_.f() + 1)
+                : nullptr;
   if (min_s > low_mark_ && stable_digest) {
+    // Adopt the certificate with the watermark: our next VIEW-CHANGE's C
+    // component must certify THIS stable seq, not the pre-jump one.
+    JsonArray adopted;
+    std::set<int64_t> seen;
+    for (const Json& d : stable_vc->checkpoint_proof) {
+      const Json* dig = d.find("digest");
+      const Json* rid = d.find("replica");
+      if (dig && dig->is_string() && dig->as_string() == *stable_digest &&
+          rid && seen.insert(rid->as_int()).second) {
+        adopted.push_back(d);
+      }
+    }
+    stable_proof_ = std::move(adopted);
     out.merge(advance_watermark(min_s, *stable_digest));
   }
   // The new primary continues the sequence after the re-issued slots.

@@ -200,8 +200,11 @@ class Replica {
   Actions on_view_change(const ViewChange& vc);
   Actions on_new_view(const NewView& nv);
   Actions maybe_new_view(int64_t v);
+  // stable_vc: the (validated) view-change whose checkpoint proof
+  // certifies min_s — the digest AND the certificate are adopted on the
+  // watermark jump (a stale proof would wedge future view changes).
   Actions enter_new_view(int64_t v, int64_t min_s,
-                         const std::string* stable_digest,
+                         const ViewChange* stable_vc,
                          const std::vector<PrePrepare>& pps);
   JsonArray prepared_proofs() const;
   std::pair<int64_t, std::vector<OEntry>> compute_o(
@@ -233,6 +236,10 @@ class Replica {
   // per client, so duplicate suppression sees unsealed requests too.
   std::vector<ClientRequest> open_batch_;
   std::map<std::string, int64_t> open_batch_ts_;
+  // Highest timestamp per client SEALED under a sequence in the current
+  // view (primary duplicate check between seal and execution; cleared on
+  // view entry so abandoned-view requests stay re-orderable).
+  std::map<std::string, int64_t> sealed_ts_;
   struct InboxEntry {
     Message msg;
     bool has_signable = false;

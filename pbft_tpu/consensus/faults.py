@@ -1,0 +1,196 @@
+"""Declarative fault schedules for the chaos simulation (ISSUE 5).
+
+A ``FaultSchedule`` is an ordered list of (step, action, args) events applied
+to a ``simulation.Cluster`` as its scheduler reaches each step — the sim
+analogue of a Jepsen nemesis timeline. Schedules serialize to/from JSON so a
+failing chaos-soak seed prints a schedule a human can read and
+``chaos_soak.py --replay SEED`` can regenerate bit-identically.
+
+``random_schedule`` draws a schedule from one seed while tracking the live
+fault budget: at most ``max_faulty`` replicas simultaneously crashed or
+Byzantine (the PBFT f bound — the safety invariants only hold under it; the
+checker-validity arm of chaos_soak deliberately exceeds it), and every
+partition/crash/fault is cleared by ``steps`` so the liveness check has a
+healed cluster to converge on.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import random
+from typing import List, Optional, Tuple
+
+from .simulation import FAULT_MODES, Cluster, LinkChaos
+
+# action -> how chaos_soak narrates it on replay.
+ACTIONS = (
+    "partition",  # args: [[rid, ...], ...]
+    "heal",  # args: []
+    "crash",  # args: [rid]
+    "revive",  # args: [rid]
+    "set_fault",  # args: [rid, mode]
+    "clear_fault",  # args: [rid]
+    "chaos",  # args: [drop_pct, dup_pct, delay_min, delay_max]
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultEvent:
+    step: int
+    action: str
+    args: Tuple = ()
+
+    def to_list(self) -> list:
+        return [self.step, self.action, list(self.args)]
+
+
+class FaultSchedule:
+    """Ordered fault events; ``apply_due`` fires everything scheduled at or
+    before the cluster's current step exactly once."""
+
+    def __init__(self, events: List[FaultEvent]):
+        self.events = sorted(events, key=lambda e: e.step)
+        self._next = 0
+
+    def __len__(self) -> int:
+        return len(self.events)
+
+    def reset(self) -> None:
+        self._next = 0
+
+    def max_step(self) -> int:
+        return self.events[-1].step if self.events else 0
+
+    def apply_due(self, cluster: Cluster, step: int) -> List[FaultEvent]:
+        """Apply every event with event.step <= step; returns them."""
+        fired = []
+        while self._next < len(self.events) and self.events[self._next].step <= step:
+            ev = self.events[self._next]
+            self._next += 1
+            self.apply(cluster, ev)
+            fired.append(ev)
+        return fired
+
+    @staticmethod
+    def apply(cluster: Cluster, ev: FaultEvent) -> None:
+        a = ev.args
+        if ev.action == "partition":
+            cluster.partition([set(g) for g in a[0]])
+        elif ev.action == "heal":
+            cluster.heal()
+        elif ev.action == "crash":
+            cluster.crash(a[0])
+        elif ev.action == "revive":
+            cluster.uncrash(a[0])
+        elif ev.action == "set_fault":
+            cluster.set_fault(a[0], a[1])
+        elif ev.action == "clear_fault":
+            cluster.clear_fault(a[0])
+        elif ev.action == "chaos":
+            drop, dup, dmin, dmax = a
+            chaos = LinkChaos(
+                drop_pct=drop, dup_pct=dup, delay_min=int(dmin), delay_max=int(dmax)
+            )
+            cluster.set_chaos(None if chaos.is_instant() else chaos)
+        else:
+            raise ValueError(f"unknown fault action {ev.action!r}")
+
+    def to_json(self) -> str:
+        return json.dumps([e.to_list() for e in self.events])
+
+    @classmethod
+    def from_json(cls, text: str) -> "FaultSchedule":
+        return cls(
+            [FaultEvent(int(s), str(a), tuple(args)) for s, a, args in json.loads(text)]
+        )
+
+    def describe(self) -> str:
+        return "\n".join(
+            f"  step {e.step:>4}: {e.action} {list(e.args)}" for e in self.events
+        )
+
+
+def random_schedule(
+    seed: int,
+    n: int,
+    steps: int,
+    max_faulty: Optional[int] = None,
+    events_every: int = 20,
+    modes: Tuple[str, ...] = FAULT_MODES,
+) -> FaultSchedule:
+    """A seeded nemesis timeline over ``steps`` scheduler rounds.
+
+    Invariants of the generated schedule (not of the run — that is the
+    checker's job): crashed+Byzantine replicas never exceed ``max_faulty``
+    (default f = (n-1)//3), and a trailing cleanup block heals partitions,
+    revives crashes, clears faults, and turns link chaos off so the
+    recovery phase starts from a connected, fault-free cluster."""
+    rng = random.Random(seed)
+    f = (n - 1) // 3
+    budget = f if max_faulty is None else max_faulty
+    crashed: set = set()
+    faulty: set = set()
+    partitioned = False
+    events: List[FaultEvent] = []
+
+    def spend() -> int:
+        return len(crashed | faulty)
+
+    step = 0
+    while True:
+        step += rng.randint(max(2, events_every // 2), events_every + events_every // 2)
+        if step >= steps:
+            break
+        roll = rng.random()
+        if roll < 0.18 and not partitioned and n >= 4:
+            members = list(range(n))
+            rng.shuffle(members)
+            cut = rng.randint(1, n - 1)
+            groups = [sorted(members[:cut]), sorted(members[cut:])]
+            events.append(FaultEvent(step, "partition", (groups,)))
+            partitioned = True
+        elif roll < 0.30 and partitioned:
+            events.append(FaultEvent(step, "heal", ()))
+            partitioned = False
+        elif roll < 0.45 and spend() < budget:
+            victim = rng.choice([r for r in range(n) if r not in crashed | faulty])
+            crashed.add(victim)
+            events.append(FaultEvent(step, "crash", (victim,)))
+        elif roll < 0.58 and crashed:
+            victim = rng.choice(sorted(crashed))
+            crashed.discard(victim)
+            events.append(FaultEvent(step, "revive", (victim,)))
+        elif roll < 0.75 and spend() < budget:
+            victim = rng.choice([r for r in range(n) if r not in crashed | faulty])
+            mode = rng.choice(list(modes))
+            faulty.add(victim)
+            events.append(FaultEvent(step, "set_fault", (victim, mode)))
+        elif roll < 0.85 and faulty:
+            victim = rng.choice(sorted(faulty))
+            faulty.discard(victim)
+            events.append(FaultEvent(step, "clear_fault", (victim,)))
+        else:
+            events.append(
+                FaultEvent(
+                    step,
+                    "chaos",
+                    (
+                        round(rng.uniform(0.0, 0.15), 3),
+                        round(rng.uniform(0.0, 0.10), 3),
+                        0,
+                        rng.randint(1, 4),
+                    ),
+                )
+            )
+    # Trailing cleanup: the liveness invariant is only promised once the
+    # network heals and the faulty set is within budget (here: empty).
+    cleanup = steps
+    if partitioned:
+        events.append(FaultEvent(cleanup, "heal", ()))
+    for rid in sorted(crashed):
+        events.append(FaultEvent(cleanup, "revive", (rid,)))
+    for rid in sorted(faulty):
+        events.append(FaultEvent(cleanup, "clear_fault", (rid,)))
+    events.append(FaultEvent(cleanup, "chaos", (0.0, 0.0, 0, 0)))
+    return FaultSchedule(events)

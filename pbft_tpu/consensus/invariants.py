@@ -1,0 +1,267 @@
+"""Machine-checked PBFT safety/liveness invariants (ISSUE 5).
+
+What "correct under faults" MEANS, as executable checks — the piece the
+happy-path integration tests structurally cannot provide (Jepsen's lesson;
+Twins for the BFT case). Two consumers:
+
+- ``InvariantChecker`` runs against a live ``simulation.Cluster`` after every
+  scheduler step (scripts/chaos_soak.py). Safety checks hold under ANY fault
+  load as long as at most f replicas are faulty; the liveness check is only
+  promised once partitions heal and the faulty set is back within budget.
+- ``check_spans`` runs against real-cluster trace data: the per-(view, seq)
+  phase-stamp slots that scripts/consensus_timeline.py builds from the PR 1
+  ``consensus_span`` events (``--check-invariants``).
+
+The safety invariants:
+
+S1  chain-digest prefix agreement — no two honest replicas ever disagree on
+    the execution-chain digest at the same sequence number. The chain digest
+    is a fold of every executed (result, seq), so equality at seq s implies
+    agreement on the entire prefix [1, s] — batch digests included.
+S2  per-(client, timestamp) exactly-once — an honest replica never emits two
+    different results for one client timestamp (cached-reply resends carry
+    the identical result by construction).
+S3  executed => committed-with-quorum — an honest replica only advances
+    executed_upto through a sequence for which 2f+1 distinct replicas sent
+    COMMIT for one digest (normal case) or a 2f+1 checkpoint certificate at
+    or beyond it exists (state-transfer catch-up). Evidence is tallied from
+    messages replicas SEND (the cluster's sent_observer feed), so link-level
+    drops cannot mask a quorum that never existed.
+
+The liveness invariant:
+
+L1  with partitions healed and <=f faulty, every submitted request
+    eventually collects f+1 matching replies from distinct replicas.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Iterable, List, Optional, Set, Tuple
+
+from .messages import Checkpoint, ClientRequest, Commit
+from .simulation import Cluster
+
+
+class InvariantViolation(AssertionError):
+    """A safety invariant failed — carries the machine-readable detail."""
+
+    def __init__(self, name: str, detail: str):
+        super().__init__(f"{name}: {detail}")
+        self.name = name
+        self.detail = detail
+
+
+class InvariantChecker:
+    """Incremental safety checker over a live simulation cluster.
+
+    ``faulty`` names the replicas currently EXEMPT from honesty checks —
+    pass a callable (e.g. ``lambda: set(cluster.faults)``) so a schedule
+    that flips fault modes mid-run keeps the exemption current. A replica
+    that was EVER faulty stays exempt: its state may have been poisoned
+    while Byzantine, and PBFT promises nothing about its local logs."""
+
+    def __init__(
+        self,
+        cluster: Cluster,
+        faulty: Optional[Callable[[], Set[int]]] = None,
+    ):
+        self.cluster = cluster
+        self._faulty_now = faulty or (lambda: set(cluster.faults))
+        self.ever_faulty: Set[int] = set()
+        # S1 evidence: rid -> {seq: chain digest hex observed there}.
+        self.digest_at: Dict[int, Dict[int, str]] = {
+            r.id: {} for r in cluster.replicas
+        }
+        self._last_executed: Dict[int, int] = {
+            r.id: r.executed_upto for r in cluster.replicas
+        }
+        # S3 evidence from sent messages: (view, seq, digest) -> commit
+        # senders; (seq, digest) -> checkpoint senders.
+        self.commit_senders: Dict[Tuple[int, int, str], Set[int]] = {}
+        self.checkpoint_senders: Dict[Tuple[int, str], Set[int]] = {}
+        # S2 evidence: (rid, client, timestamp) -> result.
+        self._reply_results: Dict[Tuple[int, str, int], str] = {}
+        self._replies_seen = 0
+        self.violations: List[InvariantViolation] = []
+        prev = cluster.sent_observer
+
+        def observe(src: int, msg) -> None:
+            if prev is not None:
+                prev(src, msg)
+            if isinstance(msg, Commit):
+                self.commit_senders.setdefault(
+                    (msg.view, msg.seq, msg.digest), set()
+                ).add(src)
+            elif isinstance(msg, Checkpoint):
+                self.checkpoint_senders.setdefault(
+                    (msg.seq, msg.digest), set()
+                ).add(src)
+
+        cluster.sent_observer = observe
+
+    # -- helpers -------------------------------------------------------------
+
+    def honest(self) -> Set[int]:
+        self.ever_faulty |= self._faulty_now()
+        return {
+            r.id for r in self.cluster.replicas if r.id not in self.ever_faulty
+        }
+
+    def _quorum(self) -> int:
+        return 2 * self.cluster.config.f + 1
+
+    def _fail(self, name: str, detail: str) -> None:
+        v = InvariantViolation(name, detail)
+        self.violations.append(v)
+        raise v
+
+    # -- the per-step safety pass -------------------------------------------
+
+    def check(self) -> None:
+        """Run S1-S3 against current cluster state; raises
+        InvariantViolation on the first failure."""
+        honest = self.honest()
+        quorum = self._quorum()
+        for r in self.cluster.replicas:
+            rid = r.id
+            prev = self._last_executed[rid]
+            cur = r.executed_upto
+            if cur < prev:
+                if rid in honest:
+                    self._fail(
+                        "executed-monotonic",
+                        f"replica {rid} executed_upto went {prev} -> {cur}",
+                    )
+                self._last_executed[rid] = cur
+                continue
+            if cur == prev:
+                continue
+            self._last_executed[rid] = cur
+            # S1 evidence: the chain digest observed at executed_upto=cur.
+            self.digest_at[rid][cur] = r.state_digest.hex()
+            if rid not in honest:
+                continue
+            # S3: each newly executed sequence must be quorum-justified.
+            for seq in range(prev + 1, cur + 1):
+                if self._committed_with_quorum(r, seq, quorum):
+                    continue
+                self._fail(
+                    "executed-without-quorum",
+                    f"replica {rid} executed seq {seq} with no 2f+1 commit "
+                    f"or checkpoint evidence",
+                )
+        # S1: prefix agreement across every honest pair with a common seq.
+        self._check_agreement(honest)
+        # S2: exactly-once on the reply stream (incremental scan).
+        self._check_replies(honest)
+
+    def _committed_with_quorum(self, replica, seq: int, quorum: int) -> bool:
+        # Normal case: 2f+1 distinct commit senders on one digest at seq.
+        for (view, s, digest), senders in self.commit_senders.items():
+            if s == seq and len(senders) >= quorum:
+                return True
+        # State-transfer case: a certified checkpoint at or beyond seq.
+        for (s, digest), senders in self.checkpoint_senders.items():
+            if s >= seq and len(senders) >= quorum:
+                return True
+        return False
+
+    def _check_agreement(self, honest: Set[int]) -> None:
+        ids = sorted(honest)
+        for i, a in enumerate(ids):
+            for b in ids[i + 1 :]:
+                da, db = self.digest_at[a], self.digest_at[b]
+                for seq in da.keys() & db.keys():
+                    if da[seq] != db[seq]:
+                        self._fail(
+                            "chain-digest-divergence",
+                            f"replicas {a} and {b} disagree at seq {seq}: "
+                            f"{da[seq][:16]}.. != {db[seq][:16]}..",
+                        )
+
+    def _check_replies(self, honest: Set[int]) -> None:
+        replies = self.cluster.client_replies
+        for rep in replies[self._replies_seen :]:
+            key = (rep.replica, rep.client, rep.timestamp)
+            prev = self._reply_results.get(key)
+            if prev is None:
+                self._reply_results[key] = rep.result
+            elif prev != rep.result and rep.replica in honest:
+                self._fail(
+                    "exactly-once",
+                    f"replica {rep.replica} replied both {prev!r} and "
+                    f"{rep.result!r} for ({rep.client}, t={rep.timestamp})",
+                )
+        self._replies_seen = len(replies)
+
+    # -- liveness ------------------------------------------------------------
+
+    def unreplied(
+        self, submitted: Iterable[ClientRequest], f: Optional[int] = None
+    ) -> List[ClientRequest]:
+        """L1 probe: the submitted requests still lacking f+1 matching
+        replies from distinct replicas. Empty list == liveness satisfied."""
+        f = self.cluster.config.f if f is None else f
+        votes: Dict[Tuple[str, int], Dict[str, Set[int]]] = {}
+        for rep in self.cluster.client_replies:
+            votes.setdefault((rep.client, rep.timestamp), {}).setdefault(
+                rep.result, set()
+            ).add(rep.replica)
+        missing = []
+        for req in submitted:
+            by_result = votes.get((req.client, req.timestamp), {})
+            if not any(len(s) >= f + 1 for s in by_result.values()):
+                missing.append(req)
+        return missing
+
+
+# -- trace-data invariants (real clusters, PR 1 span events) -----------------
+
+_PHASE_ORDER = ("request", "pre_prepare", "prepared", "committed", "executed")
+
+
+def check_spans(slots: Dict) -> List[str]:
+    """Invariant scan over consensus_span timeline slots
+    ({(view, seq) -> {rid -> {phase -> ts}}}, the structure
+    scripts/consensus_timeline.py builds). Trace data carries no digests,
+    so this checks the observable protocol-order invariants:
+
+    - phase monotonicity: within one (view, seq, replica), stamps respect
+      request <= pre_prepare <= prepared <= committed <= executed;
+    - executed-order: a replica's executed stamps are non-decreasing in
+      sequence (in-order execution — PBFT's determinism requirement);
+    - single-execution: no replica executes one sequence in two views.
+
+    Returns a list of human-readable problem strings (empty = clean)."""
+    problems: List[str] = []
+    by_replica: Dict[int, List[Tuple[int, int, float]]] = {}
+    seq_views: Dict[Tuple[int, int], Set[int]] = {}
+    for (view, seq), per in slots.items():
+        for rid, stamps in per.items():
+            chain = [(p, stamps[p]) for p in _PHASE_ORDER if p in stamps]
+            for (pa, ta), (pb, tb) in zip(chain, chain[1:]):
+                if tb < ta:
+                    problems.append(
+                        f"replica {rid} (v={view}, n={seq}): {pb} stamp "
+                        f"precedes {pa} ({tb:.6f} < {ta:.6f})"
+                    )
+            if "executed" in stamps and not stamps.get("estimated"):
+                by_replica.setdefault(rid, []).append(
+                    (seq, view, stamps["executed"])
+                )
+                seq_views.setdefault((rid, seq), set()).add(view)
+    for (rid, seq), views in seq_views.items():
+        if len(views) > 1:
+            problems.append(
+                f"replica {rid} executed seq {seq} in multiple views "
+                f"{sorted(views)}"
+            )
+    for rid, rows in by_replica.items():
+        rows.sort()
+        for (s0, v0, t0), (s1, v1, t1) in zip(rows, rows[1:]):
+            if t1 < t0:
+                problems.append(
+                    f"replica {rid}: seq {s1} executed at {t1:.6f}, before "
+                    f"seq {s0} at {t0:.6f} (out-of-order execution)"
+                )
+    return problems

@@ -94,6 +94,29 @@ def _host_sign(seed: bytes, msg: bytes) -> bytes:
     return _HOST_SIGNER(seed, msg)
 
 
+_HOST_VERIFIER = None
+
+
+def _host_verify(pub: bytes, msg: bytes, sig: bytes) -> bool:
+    """Host-side inline verification (view-change evidence): the native
+    C++ verifier when built, else the pure-Python oracle — identical
+    accept sets (tests/test_native_crypto.py), so the choice cannot
+    diverge replicas. Matters under chaos: a view-change storm verifies
+    hundreds of nested certificate signatures inline, and the ~4 ms
+    Python oracle turns each storm into seconds."""
+    global _HOST_VERIFIER
+    if _HOST_VERIFIER is None:
+        _HOST_VERIFIER = crypto.verify
+        try:
+            from .. import native
+
+            if native.available():
+                _HOST_VERIFIER = native.verify
+        except Exception:  # pragma: no cover - unbuilt native core
+            pass
+    return _HOST_VERIFIER(pub, msg, sig)
+
+
 def default_app(operation: str, seq: int) -> str:
     """The reference's execution is a no-op with a hardcoded result
     (reference src/message.rs:70); kept as the default app."""
@@ -165,6 +188,15 @@ class Replica:
         # also sees requests that sit in the unsealed batch.
         self._open_batch: List[ClientRequest] = []
         self._open_batch_ts: Dict[str, int] = {}
+        # Highest timestamp per client this primary has SEALED under a
+        # sequence number in the CURRENT view (PBFT §4.2: "the primary
+        # checks its log" — without this, a client retransmission arriving
+        # after the seal but before execution gets ordered AGAIN, burning
+        # a whole three-phase instance on a duplicate the execution-time
+        # exactly-once guard then skips). Cleared on view entry: a request
+        # sealed in an ABANDONED view may need re-ordering by the new
+        # primary, so the memory must not outlive the view.
+        self._sealed_ts: Dict[str, int] = {}
         self.counters: Dict[str, int] = {
             "sig_verified": 0,
             "sig_rejected": 0,
@@ -227,6 +259,12 @@ class Replica:
         if pending is not None and req.timestamp <= pending:
             self.counters["duplicate_requests"] += 1
             return []
+        sealed = self._sealed_ts.get(req.client)
+        if sealed is not None and req.timestamp <= sealed:
+            # Already ordered in this view (sealed, in flight): either it
+            # commits here, or a view change clears this memory.
+            self.counters["duplicate_requests"] += 1
+            return []
         self._open_batch.append(req)
         self._open_batch_ts[req.client] = req.timestamp
         if len(self._open_batch) >= max(1, self.config.batch_max_items):
@@ -252,6 +290,8 @@ class Replica:
         batch = tuple(self._open_batch)
         self._open_batch = []
         self._open_batch_ts = {}
+        for req in batch:
+            self._sealed_ts[req.client] = req.timestamp
         self.seq_counter += 1
         n = self.seq_counter
         hook = self.phase_hook
@@ -681,7 +721,7 @@ class Replica:
             return False
         if len(sig) != 64:
             return False
-        return crypto.verify(
+        return _host_verify(
             self.config.identity(replica_id).pubkey_bytes(), signable, sig
         )
 
@@ -859,12 +899,30 @@ class Replica:
                 return dig
         return None
 
-    def _stable_digest_for(self, vcs: List[ViewChange], min_s: int) -> Optional[str]:
+    def _stable_cert_for(
+        self, vcs: List[ViewChange], min_s: int
+    ) -> Optional[Tuple[str, List[dict]]]:
+        """(digest, 2f+1 matching checkpoint dicts) certifying min_s, from
+        the view-change evidence. The PROOF rides along with the digest
+        because a replica whose watermark advances through a NEW-VIEW's
+        min_s (not its own checkpoint collection) must ADOPT the
+        certificate too: its next VIEW-CHANGE claims last_stable_seq =
+        min_s, and validators reject a claim whose attached proof still
+        certifies the old (pre-jump) checkpoint — a stale proof wedges
+        every future view change that needs this replica's vote (found by
+        the chaos soak: seed 13's cluster livelocked exactly this way)."""
         for vc in vcs:
             if vc.last_stable_seq == min_s and vc.checkpoint_proof:
                 dig = self._majority_digest(vc.checkpoint_proof)
                 if dig is not None:
-                    return dig
+                    proof, seen = [], set()
+                    for d in vc.checkpoint_proof:
+                        d = dict(d)
+                        rid = d.get("replica")
+                        if d.get("digest") == dig and rid not in seen:
+                            seen.add(rid)
+                            proof.append(d)
+                    return dig, proof
         return None
 
     def _maybe_new_view(self, v: int) -> List[Action]:
@@ -904,7 +962,7 @@ class Replica:
         self.new_view_sent.add(v)
         out: List[Action] = [Broadcast(nv)]
         out.extend(
-            self._enter_new_view(v, min_s, self._stable_digest_for(vcs, min_s), pps)
+            self._enter_new_view(v, min_s, self._stable_cert_for(vcs, min_s), pps)
         )
         return out
 
@@ -949,25 +1007,30 @@ class Replica:
             if not self._verify_inline(pp.replica, pp.signable(), pp.sig):
                 return []
         return self._enter_new_view(
-            nv.new_view, min_s, self._stable_digest_for(vcs, min_s), pps
+            nv.new_view, min_s, self._stable_cert_for(vcs, min_s), pps
         )
 
     def _enter_new_view(
         self,
         v: int,
         min_s: int,
-        stable_digest: Optional[str],
+        stable_cert: Optional[Tuple[str, List[dict]]],
         pps: List[PrePrepare],
     ) -> List[Action]:
         self.view = v
         self.in_view_change = False
         self.pending_view = 0
+        self._sealed_ts = {}  # per-view primary ordering memory
         self.counters["view_changes_completed"] += 1
         for past in [w for w in self.view_changes if w <= v]:
             del self.view_changes[past]
         out: List[Action] = []
-        if min_s > self.low_mark and stable_digest is not None:
+        if min_s > self.low_mark and stable_cert is not None:
+            stable_digest, stable_proof = stable_cert
             out.extend(self._advance_watermark(min_s, stable_digest))
+            # Adopt the certificate with the watermark: our next
+            # VIEW-CHANGE's C component must certify THIS stable seq.
+            self.stable_proof = stable_proof
         # The new primary continues the sequence after the re-issued slots;
         # harmless for backups (their seq_counter is unused until they lead).
         # low_mark is included: when this replica's stable checkpoint is

@@ -3,19 +3,45 @@
 SURVEY.md §4 item 2 — the analogue of the reference's libp2p swarm for
 testing: byte-faithful message passing (frames go through to_wire/from_wire so
 encoding bugs can't hide), per-replica inboxes, pluggable signature-verifier
-backend (cpu oracle or the JAX batch kernel), link-failure and Byzantine
-fault injection.
+backend (cpu oracle or the JAX batch kernel), and a seeded chaos transport
+(ISSUE 5): per-link delay distributions, probabilistic drop/duplication,
+reordering, asymmetric partitions, crash realism, and replica-level Byzantine
+behavior modes (sig-corrupt / mute / stutter / equivocate). Everything the
+chaos layer does is driven by one ``random.Random`` stream derived from the
+cluster seed, so a failing schedule replays deterministically
+(scripts/chaos_soak.py --replay SEED).
 """
 
 from __future__ import annotations
 
+import dataclasses
 import random
 from typing import Callable, Dict, List, Optional, Tuple
 
 from ..crypto import ref as crypto
 from .config import ClusterConfig, make_local_cluster
-from .messages import ClientReply, ClientRequest, Message, from_wire, to_wire
-from .replica import Broadcast, Replica, Reply, Send
+from .messages import (
+    ClientReply,
+    ClientRequest,
+    Commit,
+    Message,
+    Prepare,
+    PrePrepare,
+    batch_digest,
+    from_wire,
+    to_wire,
+    with_sig,
+)
+from .replica import Broadcast, Replica, Reply, Send, _host_sign
+
+# Replica-level Byzantine behavior modes (the sim arm of the cross-runtime
+# --fault flag; core/pbftd.cc and net/server.py accept the same names).
+FAULT_MODES = ("sig-corrupt", "mute", "stutter", "equivocate")
+
+# Deterministic equivocation transform: variant B of a batch mutates every
+# operation with this suffix (recomputed digest, re-signed). Shared with the
+# real daemons so cross-runtime tests recognize equivocated executions.
+EQUIV_SUFFIX = "#equiv"
 
 
 def cpu_verifier(items: List[Tuple[bytes, bytes, bytes]]) -> List[bool]:
@@ -29,6 +55,24 @@ def jax_verifier(items: List[Tuple[bytes, bytes, bytes]]) -> List[bool]:
     from ..parallel import verify_many_auto
 
     return verify_many_auto(items)
+
+
+@dataclasses.dataclass(frozen=True)
+class LinkChaos:
+    """Per-link fault distribution, sampled from the cluster's seeded RNG.
+
+    delay_min/delay_max are in *steps* (the sim's time unit): each delivery
+    waits a uniform number of extra scheduler rounds, which — combined with
+    per-step inbox shuffling — yields reordering. drop_pct / dup_pct are
+    per-delivery probabilities in [0, 1]."""
+
+    drop_pct: float = 0.0
+    dup_pct: float = 0.0
+    delay_min: int = 0
+    delay_max: int = 0
+
+    def is_instant(self) -> bool:
+        return self.delay_max <= 0 and self.drop_pct <= 0 and self.dup_pct <= 0
 
 
 class Cluster:
@@ -46,6 +90,7 @@ class Cluster:
         if config is None:
             config, seeds = make_local_cluster(n)
         self.config = config
+        self.seeds = seeds
 
         def _app_kw():
             # app_factory gives each replica its OWN app instance — required
@@ -61,16 +106,47 @@ class Cluster:
         self.inboxes: Dict[int, List[Message]] = {i: [] for i in range(config.n)}
         self.client_replies: List[ClientReply] = []
         self.rng = random.Random(seed)
+        # The chaos layer draws from its OWN stream so enabling/disabling it
+        # never perturbs the legacy shuffle stream (seeded reproducibility
+        # of pre-chaos tests), while both derive from the one cluster seed.
+        self.chaos_rng = random.Random((seed << 1) ^ 0xC4A05)
         self.shuffle = shuffle
         self.dropped_links: set[Tuple[int, int]] = set()  # (src, dst)
-        # outbound_mutator(src, msg) -> Message | None; Byzantine injection.
+        # outbound_mutator(src, msg) -> Message | None; ad-hoc Byzantine
+        # injection (the original hook; fault modes below are the
+        # declarative layer on top of the same interception point).
         self.outbound_mutator: Optional[Callable] = None
+        # sent_observer(src, msg): every concrete protocol message a
+        # replica puts on the wire, AFTER fault-mode mutation (what was
+        # actually sent, per destination) but before link drops — the
+        # invariant checker's quorum-evidence feed. A Byzantine replica
+        # that equivocates is observed voting both ways, which is exactly
+        # the evidence model the safety checker needs.
+        self.sent_observer: Optional[Callable[[int, Message], None]] = None
         self.sig_verifications = 0
         if callable(verifier):
             self.verify = verifier
         else:
             self.verify = {"cpu": cpu_verifier, "jax": jax_verifier}[verifier]
         self._timestamp = 0
+        # -- chaos state ----------------------------------------------------
+        self.step_count = 0
+        self.crashed: set[int] = set()
+        self.faults: Dict[int, str] = {}  # replica -> FAULT_MODES entry
+        self.partitions: List[set] = []  # symmetric components; [] = whole
+        self.default_chaos: Optional[LinkChaos] = None
+        self.link_chaos: Dict[Tuple[int, int], LinkChaos] = {}
+        # Delayed deliveries: (deliver_at_step, tie_break, dst, Message).
+        self._in_flight: List[Tuple[int, int, int, Message]] = []
+        self._flight_seq = 0
+        # Per-replica history of sent messages, for the stutter mode.
+        self._sent_history: Dict[int, List[Message]] = {}
+        # Equivocation engine: (view, seq) -> (digest_a, digest_b,
+        # variant-b requests). Shared across colluding equivocators so a
+        # faulty backup's prepares/commits track the same two-face split.
+        self._equiv: Dict[Tuple[int, int], Tuple[str, str, tuple]] = {}
+        self.faults_injected = 0
+        self.chaos_dropped = 0
 
     # -- client side --------------------------------------------------------
 
@@ -86,6 +162,8 @@ class Cluster:
             timestamp = self._timestamp
         req = ClientRequest(operation=operation, timestamp=timestamp, client=client)
         dest = to_replica if to_replica is not None else self.primary_id
+        if dest in self.crashed:
+            return req  # a crashed replica accepts no connections
         self._route(dest, dest, req)  # client link: no mutation, no drop
         return req
 
@@ -94,6 +172,61 @@ class Cluster:
         view = max(r.view for r in self.replicas)
         return self.config.primary_of(view)
 
+    # -- fault schedule surface ---------------------------------------------
+
+    def set_fault(self, replica_id: int, mode: Optional[str]) -> None:
+        """Install (or with ``None`` clear) a Byzantine behavior mode."""
+        if mode is None:
+            self.faults.pop(replica_id, None)
+            return
+        if mode not in FAULT_MODES:
+            raise ValueError(f"unknown fault mode {mode!r}")
+        self.faults[replica_id] = mode
+
+    def clear_fault(self, replica_id: int) -> None:
+        self.set_fault(replica_id, None)
+
+    def set_chaos(
+        self,
+        chaos: Optional[LinkChaos],
+        src: Optional[int] = None,
+        dst: Optional[int] = None,
+    ) -> None:
+        """Attach a LinkChaos distribution: cluster-wide by default, or to
+        the one directed (src, dst) link when both are given."""
+        if src is None and dst is None:
+            self.default_chaos = chaos
+        elif src is not None and dst is not None:
+            if chaos is None:
+                self.link_chaos.pop((src, dst), None)
+            else:
+                self.link_chaos[(src, dst)] = chaos
+        else:
+            raise ValueError("give both src and dst, or neither")
+
+    def partition(self, groups) -> None:
+        """Split the cluster into components: links between groups are
+        severed in BOTH directions (use ``dropped_links`` directly for
+        asymmetric, single-direction cuts). Replicas named in no group
+        form one implicit remainder component together."""
+        groups = [set(g) for g in groups]
+        named = set().union(*groups) if groups else set()
+        rest = set(range(self.config.n)) - named
+        if rest:
+            groups.append(rest)
+        self.partitions = groups
+
+    def heal(self) -> None:
+        """Remove every partition (symmetric cuts only — asymmetric
+        ``dropped_links`` entries are the caller's to clear)."""
+        self.partitions = []
+
+    def _partitioned(self, src: int, dst: int) -> bool:
+        for g in self.partitions:
+            if src in g:
+                return dst not in g
+        return False
+
     # -- transport ----------------------------------------------------------
 
     def _route(self, src: int, dst: int, msg: Message) -> None:
@@ -101,14 +234,21 @@ class Cluster:
         self.inboxes[dst].append(from_wire(frame[4:]))
 
     def _emit(self, src: int, actions) -> None:
+        muted = self.faults.get(src) == "mute"
         for act in actions:
             if isinstance(act, Broadcast):
                 for dst in range(self.config.n):
                     if dst != src:
                         self._deliver(src, dst, act.msg)
             elif isinstance(act, Send):
-                self._deliver(src, act.dest, act.msg)
+                if act.dest == src:
+                    self._route(src, src, act.msg)  # self-delivery: no faults
+                else:
+                    self._deliver(src, act.dest, act.msg)
             elif isinstance(act, Reply):
+                if muted:
+                    self.faults_injected += 1
+                    continue  # a mute replica never dials the client back
                 self.client_replies.append(act.msg)
 
     def _deliver(self, src: int, dst: int, msg: Message) -> None:
@@ -118,15 +258,145 @@ class Cluster:
             msg = self.outbound_mutator(src, msg)
             if msg is None:
                 return
-        self._route(src, dst, msg)
+        for out in self._apply_fault(src, dst, msg):
+            if self.sent_observer is not None:
+                self.sent_observer(src, out)
+            self._enqueue(src, dst, out)
+
+    # -- Byzantine behavior modes -------------------------------------------
+
+    def _resign(self, src: int, msg: Message) -> Message:
+        return with_sig(msg, _host_sign(self.seeds[src], msg.signable()).hex())
+
+    def _equiv_variant(self, src: int, pp: PrePrepare):
+        """Variant B of a pre-prepare: every operation mutated, digest
+        recomputed, re-signed with the sender's own key — both variants
+        carry VALID signatures, which is what makes equivocation a real
+        attack rather than a corrupt-signature reject."""
+        key = (pp.view, pp.seq)
+        if key not in self._equiv:
+            if not pp.requests:
+                return None  # empty (gap-filler) batch: nothing to fork
+            reqs_b = tuple(
+                dataclasses.replace(r, operation=r.operation + EQUIV_SUFFIX)
+                for r in pp.requests
+            )
+            self._equiv[key] = (pp.digest, batch_digest(reqs_b), reqs_b)
+        return self._equiv[key]
+
+    def _apply_fault(self, src: int, dst: int, msg: Message) -> List[Message]:
+        """The sender-side fault engine: 0..n concrete messages out."""
+        mode = self.faults.get(src)
+        if mode is None:
+            return [msg]
+        if mode == "mute":
+            self.faults_injected += 1
+            return []
+        if mode == "sig-corrupt":
+            sig = getattr(msg, "sig", "")
+            if sig:
+                self.faults_injected += 1
+                return [with_sig(msg, "f" * len(sig))]
+            return [msg]
+        if mode == "stutter":
+            history = self._sent_history.setdefault(src, [])
+            out = [msg]
+            if history and self.chaos_rng.random() < 0.3:
+                self.faults_injected += 1
+                out.append(self.chaos_rng.choice(history))
+            history.append(msg)
+            del history[:-32]
+            return out
+        # equivocate: two-face delivery. The primary's pre-prepare forks
+        # into (A, B); a colluding equivocator's prepares/commits for a
+        # forked slot track the variant their destination saw. Group split
+        # is by destination parity — deterministic, so several equivocating
+        # replicas (an over-budget f+1 run) automatically collude, which is
+        # exactly the scenario the safety checker must catch.
+        if isinstance(msg, PrePrepare) and msg.replica == src:
+            var = self._equiv_variant(src, msg)
+            if var is None:
+                return [msg]
+            self.faults_injected += 1
+            if dst % 2 == 0:
+                return [msg]
+            _, digest_b, reqs_b = var
+            return [
+                self._resign(
+                    src,
+                    dataclasses.replace(
+                        msg, digest=digest_b, requests=reqs_b, sig=""
+                    ),
+                )
+            ]
+        if isinstance(msg, (Prepare, Commit)):
+            var = self._equiv.get((msg.view, msg.seq))
+            if var is not None and msg.digest in var[:2]:
+                self.faults_injected += 1
+                digest = var[0] if dst % 2 == 0 else var[1]
+                if digest == msg.digest:
+                    return [msg]
+                return [
+                    self._resign(
+                        src, dataclasses.replace(msg, digest=digest, sig="")
+                    )
+                ]
+        return [msg]
+
+    # -- the chaos link ------------------------------------------------------
+
+    def _enqueue(self, src: int, dst: int, msg: Message) -> None:
+        if self._partitioned(src, dst):
+            self.chaos_dropped += 1
+            return
+        chaos = self.link_chaos.get((src, dst), self.default_chaos)
+        copies = 1
+        delay = 0
+        if chaos is not None and not chaos.is_instant():
+            if chaos.drop_pct > 0 and self.chaos_rng.random() < chaos.drop_pct:
+                self.chaos_dropped += 1
+                return
+            if chaos.dup_pct > 0 and self.chaos_rng.random() < chaos.dup_pct:
+                copies = 2
+            if chaos.delay_max > 0:
+                delay = self.chaos_rng.randint(
+                    min(chaos.delay_min, chaos.delay_max), chaos.delay_max
+                )
+        for _ in range(copies):
+            if delay <= 0:
+                if dst not in self.crashed:
+                    self._route(src, dst, msg)
+            else:
+                self._flight_seq += 1
+                self._in_flight.append(
+                    (self.step_count + delay, self._flight_seq, dst, msg)
+                )
+
+    def _inject_due(self) -> None:
+        if not self._in_flight:
+            return
+        still, due = [], []
+        for entry in self._in_flight:
+            (due if entry[0] <= self.step_count else still).append(entry)
+        self._in_flight = still
+        for _, _, dst, msg in sorted(due):
+            if dst in self.crashed:
+                self.chaos_dropped += 1  # arrived at a dead replica
+                continue
+            self._route(dst, dst, msg)  # already fault/link-processed
 
     # -- scheduler ----------------------------------------------------------
 
     def step(self) -> bool:
-        """One round: every replica ingests its inbox, verifies the batch,
-        processes. Returns True if any message moved."""
+        """One round: due in-flight messages land, then every live replica
+        ingests its inbox, verifies the batch, processes. Returns True if
+        any message moved or is still in flight."""
+        self.step_count += 1
+        self._inject_due()
         moved = False
         for rid, replica in enumerate(self.replicas):
+            if rid in self.crashed:
+                continue  # a crashed replica does no work at all
             queue, self.inboxes[rid] = self.inboxes[rid], []
             if not queue:
                 continue
@@ -142,7 +412,7 @@ class Cluster:
                 self.sig_verifications += len(items)
                 actions.extend(replica.deliver_verdicts(verdicts))
             self._emit(rid, actions)
-        return moved
+        return moved or bool(self._in_flight)
 
     def run(self, max_steps: int = 200) -> int:
         steps = 0
@@ -153,16 +423,17 @@ class Cluster:
     # -- fault / timer injection --------------------------------------------
 
     def crash(self, replica_id: int) -> None:
-        """Crash-stop: sever every link to and from the replica."""
-        for other in range(self.config.n):
-            self.dropped_links.add((replica_id, other))
-            self.dropped_links.add((other, replica_id))
+        """Crash-stop: the replica stops processing entirely — its inbox is
+        discarded (no drain, no signature verification), deliveries to it
+        are dropped, and ``submit(to_replica=...)`` can no longer reach it."""
+        self.crashed.add(replica_id)
+        self.inboxes[replica_id] = []
+        self.replicas[replica_id]._inbox = []
 
     def uncrash(self, replica_id: int) -> None:
-        """Heal every link to and from the replica (recovery after crash)."""
-        for other in range(self.config.n):
-            self.dropped_links.discard((replica_id, other))
-            self.dropped_links.discard((other, replica_id))
+        """Recover a crashed replica (state intact, inbox empty — it must
+        catch up via checkpoints/state transfer like a real restart)."""
+        self.crashed.discard(replica_id)
 
     def trigger_view_change(self, replica_ids=None, new_view=None) -> None:
         """Fire the (runtime-owned) request timers: each listed replica
@@ -170,8 +441,10 @@ class Cluster:
         layer calls Replica.start_view_change when a forwarded request
         isn't executed before its timeout."""
         if replica_ids is None:
-            replica_ids = [r.id for r in self.replicas]
+            replica_ids = [r.id for r in self.replicas if r.id not in self.crashed]
         for rid in replica_ids:
+            if rid in self.crashed:
+                continue
             self._emit(rid, self.replicas[rid].start_view_change(new_view))
 
     # -- assertions helpers -------------------------------------------------
@@ -183,7 +456,11 @@ class Cluster:
         """The client's acceptance rule: f+1 matching replies (PBFT §4.1)."""
         f = self.config.f if f is None else f
         by_result: Dict[str, int] = {}
+        seen: set[Tuple[int, str]] = set()
         for r in self.replies_for(timestamp):
+            if (r.replica, r.result) in seen:
+                continue  # one vote per (replica, result): dups don't count
+            seen.add((r.replica, r.result))
             by_result[r.result] = by_result.get(r.result, 0) + 1
         for result, count in by_result.items():
             if count >= f + 1:

@@ -189,10 +189,15 @@ class PbftClient:
         timeout: float = 20.0,
         retry_every: float = 2.0,
     ) -> str:
-        """The paper's client liveness rule: send to the primary; if no
-        f+1 reply quorum before the retransmission timer, broadcast to ALL
-        replicas (forcing forwards + eventually a view change on a faulty
-        primary) and keep retrying until the deadline."""
+        """The paper's client liveness rule, hardened for chaos (ISSUE 5):
+        send to the primary; on each retransmission timer expiry, ROTATE
+        the direct target (a muted/partitioned primary must not consume
+        the whole deadline) AND broadcast to all replicas (forcing
+        forwards + eventually a view change on a faulty primary), with
+        jittered exponential backoff between retries so a thundering herd
+        of retrying clients de-synchronizes instead of beating the
+        cluster in lockstep."""
+        import random as _random
         import time as _time
 
         self._timestamp += 1
@@ -208,18 +213,28 @@ class PbftClient:
                 ) as s:
                     s.sendall(payload)
             except OSError:
-                pass  # dead replica: that's what the broadcast is for
+                pass  # dead replica: that's what the rotation/broadcast is for
 
         send_to(0)
         deadline = _time.monotonic() + timeout
+        attempt = 0
+        rng = _random.Random()
         while True:
+            # Jittered exponential backoff, capped: base * 1.5^attempt,
+            # scaled by a uniform 0.5..1.5 factor, never past the deadline.
+            wait = min(retry_every * (1.5 ** attempt), 4 * retry_every)
+            wait *= 0.5 + rng.random()
+            wait = min(wait, max(0.1, deadline - _time.monotonic()))
             try:
-                return self.wait_result(
-                    ts, timeout=min(retry_every, max(0.1, deadline - _time.monotonic()))
-                )
+                return self.wait_result(ts, timeout=wait)
             except TimeoutError:
                 if _time.monotonic() >= deadline:
                     raise
+                attempt += 1
+                # Rotate the direct target across replicas, then broadcast
+                # (the §4.1 rule) — the rotation guarantees some honest
+                # replica hears us even when specific links are dead.
+                send_to(attempt % self.config.n)
                 for rid in range(self.config.n):
                     send_to(rid)
 

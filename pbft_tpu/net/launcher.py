@@ -64,6 +64,10 @@ class LocalCluster:
         batch_max_items: "int | List[int]" = 1,
         batch_flush_us: "int | List[int]" = 0,
         extra_env: Optional[List[Optional[dict]]] = None,
+        faults: Optional[dict] = None,
+        chaos_drop_pct: float = 0.0,
+        chaos_delay_ms: int = 0,
+        chaos_seed: Optional[int] = None,
     ):
         self.trace_dir = trace_dir
         # Request batching (ISSUE 4): scalars land in network.json; lists
@@ -87,6 +91,15 @@ class LocalCluster:
         # (--byzantine, both runtimes; the real-daemon analogue of the
         # simulation's outbound mutator).
         self.byzantine = set(byzantine or [])
+        # Generalized fault injection (ISSUE 5): {replica_id: mode} maps
+        # to --fault on the daemon (sig-corrupt|mute|stutter|equivocate),
+        # and the chaos_* scalars become seeded --chaos-* link knobs on
+        # EVERY replica (per-replica seeds derive from chaos_seed + id so
+        # one scalar still gives each daemon its own stream).
+        self.faults = dict(faults or {})
+        self.chaos_drop_pct = chaos_drop_pct
+        self.chaos_delay_ms = chaos_delay_ms
+        self.chaos_seed = chaos_seed
         self.discovery = discovery
         if config is None:
             config, seeds = make_local_cluster(n, base_port=0)
@@ -181,6 +194,16 @@ class LocalCluster:
                 cmd += ["--trace", str(Path(self.trace_dir) / f"replica-{i}.jsonl")]
             if i in self.byzantine:
                 cmd += ["--byzantine"]
+            if self.faults.get(i):
+                cmd += ["--fault", str(self.faults[i])]
+            if self.chaos_drop_pct > 0:
+                cmd += ["--chaos-drop-pct", str(self.chaos_drop_pct)]
+            if self.chaos_delay_ms > 0:
+                cmd += ["--chaos-delay-ms", str(self.chaos_delay_ms)]
+            if (self.chaos_drop_pct > 0 or self.chaos_delay_ms > 0) and (
+                self.chaos_seed is not None
+            ):
+                cmd += ["--chaos-seed", str(self.chaos_seed + i)]
             self._cmds.append((cmd, env))
             self.procs.append(
                 subprocess.Popen(
@@ -251,16 +274,67 @@ class LocalCluster:
         self.procs[replica_id].terminate()
         self.procs[replica_id].wait(timeout=5)
 
-    def revive(self, replica_id: int) -> None:
+    _KEEP = object()  # revive() sentinel: carry the original launch flag
+
+    def revive(
+        self,
+        replica_id: int,
+        fault=_KEEP,
+        chaos_drop_pct=_KEEP,
+        chaos_delay_ms=_KEEP,
+    ) -> None:
         """Restart a killed replica with FRESH state (recovery scenario:
-        it must catch up via checkpoints + state transfer, PBFT §5.3)."""
+        it must catch up via checkpoints + state transfer, PBFT §5.3).
+
+        By default the revived daemon CARRIES the fault/chaos flags of the
+        original launch, so kill -> revive composes with fault schedules
+        instead of silently swapping in a clean replica. Pass
+        ``fault=None`` / ``chaos_*=0`` to revive clean(er), or a new
+        mode/value to change the behavior across the restart."""
         cmd, env = self._cmds[replica_id]
+        if fault is not self._KEEP or chaos_drop_pct is not self._KEEP or (
+            chaos_delay_ms is not self._KEEP
+        ):
+            cmd = self._strip_fault_flags(
+                list(cmd),
+                strip_fault=fault is not self._KEEP,
+                strip_drop=chaos_drop_pct is not self._KEEP,
+                strip_delay=chaos_delay_ms is not self._KEEP,
+            )
+            if fault is not self._KEEP and fault:
+                cmd += ["--fault", str(fault)]
+            if chaos_drop_pct is not self._KEEP and chaos_drop_pct > 0:
+                cmd += ["--chaos-drop-pct", str(chaos_drop_pct)]
+            if chaos_delay_ms is not self._KEEP and chaos_delay_ms > 0:
+                cmd += ["--chaos-delay-ms", str(chaos_delay_ms)]
+            self._cmds[replica_id] = (cmd, env)
         log = open(
             Path(self.tmpdir.name) / f"replica-{replica_id}.log", "ab"
         )
         self.procs[replica_id] = subprocess.Popen(
             cmd, stdout=log, stderr=log, close_fds=True, env=env
         )
+
+    @staticmethod
+    def _strip_fault_flags(cmd, strip_fault, strip_drop, strip_delay):
+        out, skip = [], 0
+        for arg in cmd:
+            if skip:
+                skip -= 1
+                continue
+            if strip_fault and arg == "--byzantine":
+                continue
+            if strip_fault and arg == "--fault":
+                skip = 1
+                continue
+            if strip_drop and arg == "--chaos-drop-pct":
+                skip = 1
+                continue
+            if strip_delay and arg == "--chaos-delay-ms":
+                skip = 1
+                continue
+            out.append(arg)
+        return out
 
     def __exit__(self, *exc) -> None:
         for p in self.procs:

@@ -23,7 +23,9 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import dataclasses
 import json
+import random
 import time
 from typing import Callable, Dict, List, Optional, Tuple
 
@@ -32,13 +34,15 @@ from ..consensus.messages import (
     ClientReply,
     ClientRequest,
     Message,
+    PrePrepare,
+    batch_digest,
     decode_payload,
     from_wire,
     signable_from_payload,
     to_binary,
     with_sig,
 )
-from ..consensus.replica import Broadcast, Replica, Reply, Send
+from ..consensus.replica import Broadcast, Replica, Reply, Send, _host_sign
 from ..utils import ConsensusSpans, MetricsRegistry, get_tracer, start_metrics_server
 from . import secure
 
@@ -108,6 +112,18 @@ def _frame_obj(obj: dict) -> bytes:
     return _frame_bytes(json.dumps(obj, separators=(",", ":")).encode())
 
 
+# Replica-level Byzantine behavior modes (--fault, ISSUE 5). Same names as
+# core/pbftd.cc --fault and the simulation's FAULT_MODES, so one chaos
+# scenario scripts identically against either daemon. "" = honest.
+FAULT_MODES = ("sig-corrupt", "mute", "stutter", "equivocate")
+
+# Deterministic equivocation transform (matches core/net.cc and
+# consensus/simulation.py): variant B mutates every operation with this
+# suffix, recomputes the batch digest, and RE-SIGNS — both variants carry
+# valid signatures, which is what makes equivocation a real attack.
+EQUIV_SUFFIX = "#equiv"
+
+
 async def _read_frame(reader, timeout: float = 10.0) -> bytes:
     hdr = await asyncio.wait_for(reader.readexactly(4), timeout)
     n = int.from_bytes(hdr, "big")
@@ -126,6 +142,10 @@ class AsyncReplicaServer:
         vc_timeout: float = 0.0,
         discovery: str = "",
         byzantine: bool = False,
+        fault: str = "",
+        chaos_drop_pct: float = 0.0,
+        chaos_delay_ms: int = 0,
+        chaos_seed: Optional[int] = None,
         metrics_port: Optional[int] = None,
     ):
         self.config = config
@@ -192,11 +212,27 @@ class AsyncReplicaServer:
         self.discovery_target = discovery
         self._discovery = None
         self._warned_no_discovery = False
-        # Fault injection (BASELINE config 5, parity with pbftd
-        # --byzantine): corrupt the signature of every outgoing protocol
-        # message AND dial-back reply; self-delivery stays honest (a
-        # Byzantine signer trusts its own messages).
-        self.byzantine = byzantine
+        # Fault injection (ISSUE 5, parity with pbftd --fault): one of
+        # FAULT_MODES, or "" for honest. ``byzantine`` is the legacy
+        # spelling of sig-corrupt. Self-delivery stays honest in every
+        # mode (a Byzantine replica trusts its own messages).
+        if fault and fault not in FAULT_MODES:
+            raise ValueError(f"unknown fault mode {fault!r}")
+        self.fault = fault or ("sig-corrupt" if byzantine else "")
+        # Seeded link-level chaos (--chaos-drop-pct / --chaos-delay-ms):
+        # outbound peer frames drop with probability drop_pct; delay
+        # holds each send for a uniform 0..delay_ms. Per-destination
+        # ordering is preserved (the per-dest link lock serializes the
+        # seal+write), so secure-channel AEAD nonces stay in sequence.
+        self.chaos_drop_pct = chaos_drop_pct
+        self.chaos_delay_ms = chaos_delay_ms
+        self._chaos_rng = random.Random(
+            chaos_seed if chaos_seed is not None else replica_id
+        )
+        self.faults_injected = 0
+        self.chaos_dropped = 0
+        # Recently broadcast messages, for the stutter mode's replays.
+        self._stutter_history: List[Message] = []
         self._server: Optional[asyncio.Server] = None
         # dest -> _PeerLink; guarded by a per-dest lock so one handshake
         # runs per destination and sealed-frame counters never interleave.
@@ -524,19 +560,83 @@ class AsyncReplicaServer:
 
     # -- outbound ------------------------------------------------------------
 
+    def _count_fault(self) -> None:
+        self.faults_injected += 1
+        if self.metrics_registry.enabled:
+            self.metrics_registry.counter("pbft_faults_injected_total").inc()
+
+    def _equivocate_variant(self, pp: PrePrepare) -> Message:
+        """Variant B of this primary's own pre-prepare: operations
+        mutated, digest recomputed, re-signed (mirrors core/net.cc)."""
+        reqs_b = tuple(
+            dataclasses.replace(r, operation=r.operation + EQUIV_SUFFIX)
+            for r in pp.requests
+        )
+        variant = dataclasses.replace(
+            pp, requests=reqs_b, digest=batch_digest(reqs_b), sig=""
+        )
+        return with_sig(
+            variant, _host_sign(self._seed, variant.signable()).hex()
+        )
+
+    def _broadcast(self, loop, msg: Message) -> None:
+        """One serialize-once fan-out of ``msg`` to every peer."""
+        self.broadcasts += 1
+        enc = _EncodedOut(self._corrupt_sig(msg), server=self)
+        for dest in range(self.config.n):
+            if dest != self.id:
+                loop.create_task(self._send_to(dest, enc))
+
     def _emit(self, actions: List) -> None:
         loop = asyncio.get_running_loop()
+        mute = self.fault == "mute"
         for act in actions:
             if isinstance(act, Broadcast):
+                if mute:  # receives but never sends (--fault mute)
+                    self._count_fault()
+                    continue
+                if (
+                    self.fault == "equivocate"
+                    and isinstance(act.msg, PrePrepare)
+                    and act.msg.replica == self.id
+                    and act.msg.requests
+                ):
+                    # The equivocating primary forks its own pre-prepare:
+                    # even peers get the genuine batch, odd peers a
+                    # conflicting validly-signed one — same (view, seq),
+                    # different digest. At <= f faulty neither side can
+                    # reach a commit quorum; the honest replicas' timers
+                    # must vote this primary out.
+                    self._count_fault()
+                    self.broadcasts += 1
+                    enc_a = _EncodedOut(act.msg, server=self)
+                    enc_b = _EncodedOut(
+                        self._equivocate_variant(act.msg), server=self
+                    )
+                    for dest in range(self.config.n):
+                        if dest != self.id:
+                            loop.create_task(
+                                self._send_to(
+                                    dest, enc_a if dest % 2 == 0 else enc_b
+                                )
+                            )
+                    continue
                 # Serialize-once fan-out: ONE canonical encode (and at
                 # most one binary-v2 encode, when any link negotiated it)
                 # per broadcast, shared by every destination task. The
                 # Byzantine corruption is applied once too.
-                self.broadcasts += 1
-                enc = _EncodedOut(self._corrupt_sig(act.msg), server=self)
-                for dest in range(self.config.n):
-                    if dest != self.id:
-                        loop.create_task(self._send_to(dest, enc))
+                self._broadcast(loop, act.msg)
+                if self.fault == "stutter":
+                    # Seeded stale replays alongside the fresh broadcast:
+                    # honest replicas must treat the replay as the
+                    # duplicate it is.
+                    if self._stutter_history and self._chaos_rng.random() < 0.3:
+                        self._count_fault()
+                        self._broadcast(
+                            loop, self._chaos_rng.choice(self._stutter_history)
+                        )
+                    self._stutter_history.append(act.msg)
+                    del self._stutter_history[:-32]
             elif isinstance(act, Send):
                 if isinstance(act.msg, ClientRequest) and self.vc_timeout > 0:
                     self._waiting_requests[
@@ -544,6 +644,8 @@ class AsyncReplicaServer:
                     ] = time.monotonic() + self.vc_timeout
                 if act.dest == self.id:
                     self._ingest(act.msg)
+                elif mute:
+                    self._count_fault()
                 else:
                     loop.create_task(
                         self._send_to(
@@ -554,6 +656,9 @@ class AsyncReplicaServer:
                 self._waiting_requests.pop(
                     (act.msg.client, act.msg.timestamp), None
                 )
+                if mute:  # a mute replica never dials the client back
+                    self._count_fault()
+                    continue
                 loop.create_task(self._dial_reply(act.client, act.msg))
         if self.metrics_registry.enabled:
             # Deltas of the replica's own counters: "executed" counts per
@@ -687,14 +792,32 @@ class AsyncReplicaServer:
     def _corrupt_sig(self, msg: Message) -> Message:
         """The Byzantine signer's outgoing message: same content, garbage
         signature (mirrors core/net.cc corrupt_sig — 'f' * len)."""
-        if not self.byzantine:
+        if self.fault != "sig-corrupt":
             return msg
         sig = getattr(msg, "sig", "")
         if not sig:
             return msg
+        self._count_fault()
         return with_sig(msg, "f" * len(sig))
 
     async def _send_to(self, dest: int, enc: _EncodedOut) -> None:
+        if self.chaos_drop_pct > 0 and (
+            self._chaos_rng.random() < self.chaos_drop_pct
+        ):
+            # Seeded link loss (--chaos-drop-pct): the frame never leaves
+            # this replica — PBFT's retransmission paths must absorb it.
+            self.chaos_dropped += 1
+            if self.metrics_registry.enabled:
+                self.metrics_registry.counter("pbft_chaos_dropped_total").inc()
+            return
+        if self.chaos_delay_ms > 0:
+            # Held BEFORE the per-dest link lock: concurrent sends wake in
+            # jittered order, so frames reorder across broadcasts, while
+            # the lock still serializes the seal+write per link (secure
+            # channels keep their AEAD nonce sequence).
+            await asyncio.sleep(
+                self._chaos_rng.random() * self.chaos_delay_ms / 1000.0
+            )
         lock = self._peer_locks.setdefault(dest, asyncio.Lock())
         async with lock:
             link = self._peer_links.get(dest)
@@ -833,6 +956,8 @@ class AsyncReplicaServer:
             "broadcast_encodes": self.broadcast_encodes,
             "codec_binary_frames": self.codec_binary_frames,
             "codec_json_frames": self.codec_json_frames,
+            "faults_injected": self.faults_injected,
+            "chaos_dropped": self.chaos_dropped,
             "executed_upto": self.replica.executed_upto,
             "low_mark": self.replica.low_mark,
             "view": self.replica.view,
@@ -858,6 +983,10 @@ async def _amain(args) -> None:
         vc_timeout=args.vc_timeout_ms / 1000.0,
         discovery=args.discovery,
         byzantine=args.byzantine,
+        fault=args.fault,
+        chaos_drop_pct=args.chaos_drop_pct,
+        chaos_delay_ms=args.chaos_delay_ms,
+        chaos_seed=args.chaos_seed,
         metrics_port=args.metrics_port,
     )
     await server.start()
@@ -915,7 +1044,35 @@ def main() -> None:
         "--byzantine",
         action="store_true",
         help="fault injection: corrupt every outgoing signature "
-        "(parity with pbftd --byzantine)",
+        "(legacy spelling of --fault sig-corrupt)",
+    )
+    parser.add_argument(
+        "--fault",
+        default="",
+        choices=("",) + FAULT_MODES,
+        help="Byzantine behavior mode (parity with pbftd --fault): "
+        "sig-corrupt | mute | stutter | equivocate",
+    )
+    parser.add_argument(
+        "--chaos-drop-pct",
+        type=float,
+        default=0.0,
+        help="seeded link chaos: drop this fraction of outbound peer "
+        "frames (0..1)",
+    )
+    parser.add_argument(
+        "--chaos-delay-ms",
+        type=int,
+        default=0,
+        help="seeded link chaos: hold each outbound peer frame for a "
+        "uniform 0..N ms",
+    )
+    parser.add_argument(
+        "--chaos-seed",
+        type=int,
+        default=None,
+        help="chaos RNG seed (default: the replica id) — same seed, same "
+        "drop/delay pattern",
     )
     parser.add_argument("--trace", default=None, help="JSONL trace file")
     args = parser.parse_args()

@@ -100,6 +100,14 @@ METRIC_SCHEMAS = {
     # pbft_consensus_rounds_total, not requests.
     "pbft_requests_executed_total": ("counter", {"server.py", "net.cc"}),
     "pbft_consensus_rounds_total": ("counter", {"server.py", "net.cc"}),
+    # Chaos/fault-injection surface (ISSUE 5): behaviors the --fault mode
+    # actually fired (corrupted signatures, equivocating pre-prepares,
+    # muted sends, stutter replays) and outbound frames the seeded
+    # --chaos-drop-pct link dropped. Both zero on a healthy replica — a
+    # nonzero value in production is an alarm, in a chaos test it is the
+    # proof the injection ran.
+    "pbft_faults_injected_total": ("counter", {"server.py", "net.cc"}),
+    "pbft_chaos_dropped_total": ("counter", {"server.py", "net.cc"}),
     "pbft_batch_size": ("histogram", {"server.py", "net.cc"}),
     "pbft_verify_batch_size": ("histogram", {"server.py", "service.py", "net.cc"}),
     "pbft_verify_seconds": ("histogram", {"server.py", "service.py", "net.cc"}),

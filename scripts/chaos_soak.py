@@ -1,0 +1,346 @@
+#!/usr/bin/env python
+"""Chaos soak: seeded randomized fault schedules through the PBFT simulator,
+with the safety/liveness invariants machine-checked at EVERY scheduler step
+(ISSUE 5 — the Jepsen-style nemesis loop for this codebase).
+
+Per seed, per cluster size: build a Cluster, draw a ``random_schedule``
+(partitions, crash/heal cycles, Byzantine modes including equivocation, link
+chaos), drip client requests in while it runs, check S1-S3 after every step,
+then heal everything and require L1 — every submitted request collects its
+f+1 matching reply quorum. Any violation prints the seed + the schedule and
+a one-command deterministic replay:
+
+    python scripts/chaos_soak.py --replay SEED [--n 4] [--steps 400]
+
+Determinism: one seed drives the schedule generator, the sim's chaos RNG,
+and the inbox shuffle — same seed => same schedule => same verdict.
+
+Checker validity (a checker that can't fail is not a checker): --validate
+runs an f+1-equivocator collusion (over the fault budget) and REQUIRES the
+safety checker to trip.
+
+Usage:
+    python scripts/chaos_soak.py --seeds 25 --steps 400          # the soak
+    python scripts/chaos_soak.py --seeds 5 --steps 120 --n 4     # smoke
+    python scripts/chaos_soak.py --replay 7 --n 7                # one seed
+    python scripts/chaos_soak.py --validate                      # trip test
+"""
+
+from __future__ import annotations
+
+import argparse
+import pathlib
+import sys
+from typing import List, Optional
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from pbft_tpu.consensus.faults import FaultSchedule, random_schedule  # noqa: E402
+from pbft_tpu.consensus.invariants import (  # noqa: E402
+    InvariantChecker,
+    InvariantViolation,
+)
+from pbft_tpu.consensus.simulation import Cluster  # noqa: E402
+
+# Scheduler rounds of zero progress before the soak fires the replicas'
+# view-change timers (the sim has no wall clock; this is its vc_timeout).
+STALL_WINDOW = 24
+# Client retransmission cadence (PBFT §4.1), deliberately DECOUPLED from
+# the view-change timer: retransmitting in the same tick a view change
+# starts would feed every retransmission into a round the new view kills.
+RETRANSMIT_EVERY = 8
+
+
+def _echo_app(operation: str, seq: int) -> str:
+    """Echo app: the result IS the operation, so the execution-chain digest
+    commits to the agreed request content — an equivocated batch that
+    sneaks into execution diverges the chain, which is what the S1 checker
+    must be able to see (the default constant-result app would mask it)."""
+    return operation
+
+
+def _pick_verifier():
+    """Native batch verifier when built (tier-1 speed), Python oracle else."""
+    try:
+        from pbft_tpu import native
+
+        if native.available():
+            return lambda items: list(native.verify_batch(items))
+    except Exception:
+        pass
+    return "cpu"
+
+
+def run_one(
+    seed: int,
+    n: int,
+    steps: int,
+    schedule: Optional[FaultSchedule] = None,
+    submit_every: int = 6,
+    recovery_steps: int = 400,
+    verbose: bool = False,
+) -> dict:
+    """One soak run. Returns {ok, seed, n, violation?, schedule, ...}."""
+    cluster = Cluster(n=n, seed=seed, shuffle=True, verifier=_pick_verifier(),
+                      app=_echo_app)
+    checker = InvariantChecker(cluster)
+    if schedule is None:
+        schedule = random_schedule(seed, n, steps)
+    schedule.reset()
+    clients = [f"10.0.0.{k}:9000" for k in range(1, 4)]
+    submitted = []
+    # The PBFT client contract: ONE outstanding request per client
+    # (PBFT §4.1). Issuing a higher timestamp while an earlier one is
+    # unreplied would let per-client exactly-once orphan the earlier
+    # request forever — a client bug, not a protocol liveness failure.
+    pending: dict = {c: None for c in clients}
+    last_progress = (0, 0)  # (step, max honest executed)
+
+    def live_target() -> int:
+        primary = cluster.primary_id
+        if primary not in cluster.crashed:
+            return primary
+        for rid in range(n):
+            if rid not in cluster.crashed:
+                return rid
+        return primary
+
+    def refresh_pending() -> None:
+        live = [req for req in pending.values() if req is not None]
+        done = {
+            (r.client, r.timestamp)
+            for r in live
+            if not checker.unreplied([r])
+        }
+        for c, req in list(pending.items()):
+            if req is not None and (req.client, req.timestamp) in done:
+                pending[c] = None
+
+    def retransmit() -> None:
+        # The client liveness rule (PBFT §4.1): rebroadcast every
+        # outstanding request to every live replica — forces forwarding
+        # and, with the timer trigger below, a view change on a faulty
+        # primary.
+        for req in pending.values():
+            if req is None:
+                continue
+            for rid in range(n):
+                if rid not in cluster.crashed:
+                    cluster.submit(
+                        req.operation,
+                        client=req.client,
+                        timestamp=req.timestamp,
+                        to_replica=rid,
+                    )
+
+    def tick(t: int, in_recovery: bool) -> Optional[dict]:
+        nonlocal last_progress
+        cluster.step()
+        try:
+            checker.check()
+        except InvariantViolation as v:
+            return {
+                "ok": False,
+                "seed": seed,
+                "n": n,
+                "step": t,
+                "violation": str(v),
+                "schedule": schedule,
+            }
+        if t % RETRANSMIT_EVERY == 5:
+            retransmit()
+        executed = max(
+            (r.executed_upto for r in cluster.replicas
+             if r.id in checker.honest() and r.id not in cluster.crashed),
+            default=0,
+        )
+        if executed > last_progress[1]:
+            last_progress = (t, executed)
+        elif t - last_progress[0] >= STALL_WINDOW:
+            # No progress for a whole window: the runtime-owned request
+            # timers would have fired by now — suspect the primary. Fire
+            # toward a COMMON target view (1 past the highest floor any
+            # live replica holds): replicas bumping +1 from their own
+            # skewed floors can chase each other forever without 2f+1
+            # VIEW-CHANGEs ever naming one view, and the f+1 join rule
+            # converges too slowly against a fixed-cadence trigger storm.
+            last_progress = (t, executed)
+            target = 1 + max(
+                (r.pending_view if r.in_view_change else r.view)
+                for r in cluster.replicas
+                if r.id not in cluster.crashed
+            )
+            if verbose:
+                print(f"    step {t}: stalled at executed={executed}; "
+                      f"firing view-change timers toward view {target}")
+            cluster.trigger_view_change(new_view=target)
+        return None
+
+    op_counter = 0
+
+    def submit_next() -> None:
+        # Round-robin over clients, skipping any with a request still in
+        # flight (one outstanding request per client, PBFT §4.1).
+        nonlocal op_counter
+        for c in clients:
+            if pending[c] is None:
+                op_counter += 1
+                req = cluster.submit(f"op-{op_counter}", client=c,
+                                     to_replica=live_target())
+                pending[c] = req
+                submitted.append(req)
+                return
+
+    for t in range(1, steps + 1):
+        for ev in schedule.apply_due(cluster, t):
+            if verbose:
+                print(f"    step {t}: {ev.action} {list(ev.args)}")
+        if t % submit_every == 0:
+            submit_next()
+        fail = tick(t, in_recovery=False)
+        if fail is not None:
+            return fail
+        refresh_pending()
+    # Recovery phase: the schedule's trailing cleanup healed partitions,
+    # revived crashes, and cleared faults — L1 must now converge.
+    for t in range(steps + 1, steps + 1 + recovery_steps):
+        fail = tick(t, in_recovery=True)
+        if fail is not None:
+            return fail
+        refresh_pending()
+        if not checker.unreplied(submitted):
+            break
+    missing = checker.unreplied(submitted)
+    if missing:
+        return {
+            "ok": False,
+            "seed": seed,
+            "n": n,
+            "step": steps + recovery_steps,
+            "violation": "liveness: %d of %d requests never reached their "
+            "f+1 reply quorum (timestamps %s)"
+            % (len(missing), len(submitted),
+               [r.timestamp for r in missing[:8]]),
+            "schedule": schedule,
+        }
+    return {
+        "ok": True,
+        "seed": seed,
+        "n": n,
+        "submitted": len(submitted),
+        "executed": max(r.executed_upto for r in cluster.replicas),
+        "faults_injected": cluster.faults_injected,
+        "chaos_dropped": cluster.chaos_dropped,
+        "schedule": schedule,
+    }
+
+
+def validate_checker(steps: int = 240, verbose: bool = False) -> dict:
+    """Checker validity: f+1 colluding equivocators (n=4, f=1, TWO faulty)
+    must produce a run the safety checker REJECTS. If this comes back
+    clean, the checker is vacuous and every green soak is meaningless."""
+    cluster = Cluster(n=4, seed=1, shuffle=True, verifier=_pick_verifier(),
+                      app=_echo_app)
+    # The colluders are exempt from honesty checks — the violation must be
+    # HONEST replicas 2 and 3 executing different batches at one sequence,
+    # the real safety break f+1 Byzantine replicas can force.
+    checker = InvariantChecker(cluster, faulty=lambda: {0, 1})
+    cluster.set_fault(0, "equivocate")  # the two-face primary...
+    cluster.set_fault(1, "equivocate")  # ...and its colluding backup
+    for t in range(1, steps + 1):
+        if t % 4 == 1:
+            cluster.submit(f"op-{t}", to_replica=0)
+        cluster.step()
+        try:
+            checker.check()
+        except InvariantViolation as v:
+            if verbose:
+                print(f"    step {t}: checker tripped: {v}")
+            return {"tripped": True, "step": t, "violation": str(v)}
+        if t % 40 == 0:
+            cluster.trigger_view_change([2, 3])
+    return {"tripped": False}
+
+
+def _print_failure(res: dict) -> None:
+    print(f"\nFAIL seed={res['seed']} n={res['n']} at step {res['step']}:")
+    print(f"  {res['violation']}")
+    print("  schedule:")
+    print(res["schedule"].describe())
+    print(
+        "  replay: python scripts/chaos_soak.py --replay %d --n %d "
+        "--steps %d" % (res["seed"], res["n"], res.get("steps", 0) or 0)
+    )
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter
+    )
+    parser.add_argument("--seeds", type=int, default=25,
+                        help="number of seeds to soak (0..N-1 + --seed-base)")
+    parser.add_argument("--seed-base", type=int, default=0)
+    parser.add_argument("--steps", type=int, default=400,
+                        help="scheduler rounds under the fault schedule")
+    parser.add_argument("--n", type=str, default="4,7",
+                        help="comma-separated cluster sizes (default 4,7)")
+    parser.add_argument("--replay", type=int, default=None,
+                        help="re-run ONE seed verbosely (deterministic)")
+    parser.add_argument("--validate", action="store_true",
+                        help="checker validity: f+1 faulty must trip safety")
+    parser.add_argument("--submit-every", type=int, default=6)
+    args = parser.parse_args(argv)
+    sizes = [int(s) for s in args.n.split(",") if s]
+
+    if args.validate:
+        res = validate_checker(verbose=True)
+        if res["tripped"]:
+            print(f"checker validity OK: f+1 equivocators tripped safety at "
+                  f"step {res['step']}: {res['violation']}")
+            return 0
+        print("checker validity FAILED: f+1 equivocators ran clean — the "
+              "safety checker is vacuous")
+        return 1
+
+    if args.replay is not None:
+        rc = 0
+        for n in sizes:
+            print(f"replaying seed {args.replay} n={n} steps={args.steps}:")
+            res = run_one(args.replay, n, args.steps,
+                          submit_every=args.submit_every, verbose=True)
+            if res["ok"]:
+                print(f"  OK: {res['submitted']} requests, "
+                      f"executed up to {res['executed']}, "
+                      f"{res['faults_injected']} faults injected, "
+                      f"{res['chaos_dropped']} chaos drops")
+            else:
+                res["steps"] = args.steps
+                _print_failure(res)
+                rc = 1
+        return rc
+
+    failures: List[dict] = []
+    for i in range(args.seeds):
+        seed = args.seed_base + i
+        for n in sizes:
+            res = run_one(seed, n, args.steps, submit_every=args.submit_every)
+            if res["ok"]:
+                print(f"seed {seed:>3} n={n}: OK  "
+                      f"({res['submitted']} reqs, exec<={res['executed']}, "
+                      f"{res['faults_injected']} faults, "
+                      f"{res['chaos_dropped']} drops)")
+            else:
+                res["steps"] = args.steps
+                _print_failure(res)
+                failures.append(res)
+    if failures:
+        print(f"\n{len(failures)} failing runs; replay any with "
+              "--replay SEED --n N --steps STEPS")
+        return 1
+    print(f"\nall {args.seeds} seeds x sizes {sizes} passed every "
+          "safety/liveness invariant")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

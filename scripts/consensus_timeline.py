@@ -219,6 +219,13 @@ def main(argv=None) -> dict:
         action="store_true",
         help="multi-host traces: clocks are not comparable across replicas",
     )
+    parser.add_argument(
+        "--check-invariants",
+        action="store_true",
+        help="run the protocol-order invariants (consensus/invariants.py "
+        "check_spans) over the merged span data: phase monotonicity, "
+        "in-order execution, single-execution per sequence",
+    )
     args = parser.parse_args(argv)
     files = expand_trace_args(args.traces)
     if not files:
@@ -229,6 +236,11 @@ def main(argv=None) -> dict:
     result = analyze(
         slots, args.straggler_ms, args.gap_ms, spread=not args.no_spread
     )
+    if args.check_invariants:
+        sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+        from pbft_tpu.consensus.invariants import check_spans
+
+        result["invariant_problems"] = check_spans(slots)
     if args.json:
         print(json.dumps(result, indent=1, sort_keys=True))
         return result
@@ -263,8 +275,18 @@ def main(argv=None) -> dict:
             f"(v={st['after'][0]}, n={st['after'][1]}) and "
             f"(v={st['before'][0]}, n={st['before'][1]})"
         )
+    if "invariant_problems" in result:
+        problems = result["invariant_problems"]
+        if problems:
+            print(f"INVARIANT VIOLATIONS ({len(problems)}):")
+            for p in problems:
+                print(f"  {p}")
+        else:
+            print("invariants: phase order, execution order, and "
+                  "single-execution all hold")
     return result
 
 
 if __name__ == "__main__":
-    main()
+    result = main()
+    sys.exit(1 if result.get("invariant_problems") else 0)

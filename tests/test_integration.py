@@ -377,6 +377,115 @@ def test_byzantine_primary_voted_out_over_secure_links():
             client.close()
 
 
+def _equivocating_primary_case(impl, secure=False):
+    """Shared body for the equivocating-primary arms: replica 0 runs
+    --fault equivocate (conflicting validly-signed pre-prepares to
+    different backups — both signatures VERIFY, unlike sig-corrupt), so
+    view 0 can never commit; the honest replicas' request timers must
+    vote it out, and the cluster must keep executing client requests in
+    the new view."""
+    import json as _json
+    import re
+    import time
+    from pathlib import Path
+
+    with LocalCluster(
+        n=4,
+        verifier="cpu",
+        impl=impl,
+        faults={0: "equivocate"},
+        secure=secure,
+        vc_timeout_ms=500,
+        metrics_every=1,
+    ) as cluster:
+        client = PbftClient(cluster.config)
+        try:
+            assert (
+                client.request_with_retry("survive-equivocation", timeout=60)
+                == "awesome!"
+            )
+            # ...and CONTINUES executing after the view change.
+            assert (
+                client.request_with_retry("post-view-change", timeout=30)
+                == "awesome!"
+            )
+            time.sleep(1.5)  # one more metrics tick
+            log0 = (Path(cluster.tmpdir.name) / "replica-0.log").read_text(
+                errors="ignore"
+            )
+            log1 = (Path(cluster.tmpdir.name) / "replica-1.log").read_text(
+                errors="ignore"
+            )
+            # The equivocation actually FIRED (else a stall from any other
+            # cause would mask an inert --fault flag)...
+            faults = re.findall(r'"faults_injected":\s*(\d+)', log0)
+            assert faults and int(faults[-1]) > 0, "equivocation never fired?"
+            # ...and the honest replicas detected no progress and moved on.
+            views = re.findall(r'"view":\s*(\d+)', log1)
+            assert views and int(views[-1]) >= 1, "primary never voted out"
+        finally:
+            client.close()
+
+
+def test_equivocating_py_primary_voted_out_over_secure_links():
+    """ISSUE 5 satellite: py-primary arm — the asyncio daemon equivocates
+    over AEAD links in a mixed cxx/py cluster and is voted out."""
+    _equivocating_primary_case(["py", "cxx", "py", "cxx"], secure=True)
+
+
+def test_equivocating_cxx_primary_voted_out_over_secure_links():
+    """ISSUE 5 satellite: cxx-primary arm of the same scenario."""
+    _equivocating_primary_case(["cxx", "py", "cxx", "py"], secure=True)
+
+
+def test_chaos_knobs_cluster_still_commits():
+    """Both daemons accept the seeded link-chaos knobs (--chaos-drop-pct /
+    --chaos-delay-ms): with 5% loss and up to 15 ms of injected delay on
+    every peer link of a mixed cluster, retransmission + timers still
+    commit client requests."""
+    with LocalCluster(
+        n=4,
+        verifier="cpu",
+        impl=["cxx", "py", "cxx", "py"],
+        chaos_drop_pct=0.05,
+        chaos_delay_ms=15,
+        chaos_seed=99,
+        vc_timeout_ms=800,
+    ) as cluster:
+        client = PbftClient(cluster.config)
+        try:
+            for k in range(3):
+                assert (
+                    client.request_with_retry(f"chaotic-{k}", timeout=45)
+                    == "awesome!"
+                )
+        finally:
+            client.close()
+
+
+def test_revive_carries_fault_flags():
+    """ISSUE 5 satellite: kill -> revive keeps the original launch's fault
+    flags by default (a schedule's faulty replica stays faulty across a
+    restart), and an explicit override revives it clean."""
+    with LocalCluster(
+        n=4, verifier="cpu", faults={3: "sig-corrupt"}
+    ) as cluster:
+        assert "--fault" in cluster._cmds[3][0]
+        cluster.kill(3)
+        cluster.revive(3)  # default: carry the fault
+        assert "--fault" in cluster._cmds[3][0]
+        client = PbftClient(cluster.config)
+        try:
+            req = client.request("with revived byzantine")
+            assert client.wait_result(req.timestamp, timeout=20) == "awesome!"
+        finally:
+            client.close()
+        cluster.kill(3)
+        cluster.revive(3, fault=None)  # override: clean restart
+        assert "--fault" not in cluster._cmds[3][0]
+        assert "--byzantine" not in cluster._cmds[3][0]
+
+
 def test_mixed_batched_and_batch1_cluster_commits():
     """ISSUE 4 acceptance: a cluster whose primary batches (pbftd,
     batch_max_items=8) while every backup runs batch_max_items=1 — and

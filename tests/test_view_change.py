@@ -156,6 +156,46 @@ def test_cascading_view_change_skips_failed_primary():
     assert c.committed_result(req.timestamp) == "awesome!"
 
 
+def test_watermark_jump_adopts_checkpoint_certificate():
+    """Chaos-soak regression (ISSUE 5, seed 13): a replica whose watermark
+    advances through a NEW-VIEW's min-s (not its own 2f+1 checkpoint
+    collection) must ADOPT the certifying checkpoint proof. Before the
+    fix it kept the stale pre-jump proof, so its next VIEW-CHANGE claimed
+    last_stable_seq = min_s with a certificate for the OLD seq — honest
+    validators reject that, and with two such replicas in an f=1 cluster
+    no view change can ever gather 2f+1 valid votes again (a permanent
+    liveness loss)."""
+    c = Cluster(n=4)
+    interval = c.config.checkpoint_interval
+    # Replica 3 misses a whole checkpoint interval.
+    c.crash(3)
+    for i in range(interval):
+        c.submit(f"op-{i}")
+        c.run(max_steps=500)
+    assert all(c.replicas[i].low_mark == interval for i in (0, 1, 2))
+    assert c.replicas[3].low_mark == 0
+    # It returns and joins a view change: min-s (= interval) reaches it
+    # via the NEW-VIEW evidence, not via 2f+1 checkpoints of its own.
+    c.uncrash(3)
+    c.trigger_view_change([1, 2, 3])
+    c.run(max_steps=500)
+    r3 = c.replicas[3]
+    assert r3.view == 1 and r3.low_mark == interval
+    # The adopted certificate must certify the NEW stable seq...
+    assert r3.stable_proof, "no certificate adopted on the watermark jump"
+    assert all(d["seq"] == interval for d in r3.stable_proof)
+    assert len(r3.stable_proof) >= 2 * c.config.f + 1
+    # ...so its next VIEW-CHANGE validates at its peers.
+    acts = r3.start_view_change()
+    vcs = [
+        a.msg
+        for a in acts
+        if isinstance(a, Broadcast) and isinstance(a.msg, ViewChange)
+    ]
+    assert vcs and vcs[0].last_stable_seq == interval
+    assert c.replicas[1]._validate_view_change(vcs[0])
+
+
 def test_view_change_message_roundtrip():
     config, seeds = make_local_cluster(4)
     r = Replica(config, 1, seeds[1])
@@ -202,8 +242,12 @@ def test_stable_digest_ignores_byzantine_first_checkpoint():
     )
     # The proof as a whole is valid (a 2f+1 majority on `good` exists)...
     assert replicas[2]._validate_view_change(vc)
-    # ...but the stable digest must be the majority one, not proof[0]'s.
-    assert replicas[2]._stable_digest_for([vc], 10) == good
+    # ...but the stable digest must be the majority one, not proof[0]'s —
+    # and the adopted certificate must carry ONLY the majority entries.
+    digest, proof = replicas[2]._stable_cert_for([vc], 10)
+    assert digest == good
+    assert len(proof) == 3
+    assert all(d["digest"] == good for d in proof)
 
 
 def _signed_reply_dict(seeds, rid, ts, result="awesome!", view=0, client="c:1"):
