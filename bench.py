@@ -1,48 +1,45 @@
-"""Benchmark: PREPARE+COMMIT signature verifications/sec on one chip.
+"""Benchmark: PREPARE+COMMIT signature verifications/sec on one host.
 
 The north-star metric (BASELINE.json): the reference intended per-message
 Ed25519 checks on every PREPARE/COMMIT (left as TODOs, reference
 src/behavior.rs:127,:185); this framework batches a window of quorum
-certificates into one XLA launch. The bench drives the batched JAX verifier
-with realistic consensus traffic shapes (32-byte signed digests, mixed
-valid/invalid) and reports sustained verifications/sec.
+certificates into one XLA launch sharded across every local device.
 
-Methodology: K kernel applications are CHAINED inside one jit (each
-iteration's input depends on the previous verdicts) and the result is read
-back to the host — so neither async dispatch nor any backend-side caching
-of repeated identical launches can fake the number. Inputs are
-device-resident during the timed region: host->device transfer over this
-dev environment's tunneled PJRT link costs ~250ms/batch, which measures
-the tunnel, not the TPU; transfer time is logged to stderr separately.
+Architecture (ISSUE 7): the accelerator is owned by a PERSISTENT verify
+service (scripts/verifyd.py), not by the bench. The service initializes
+the backend once per deploy, AOT-warms every pad-ladder window shape, and
+answers a readiness handshake; the bench:
 
-Robustness (the same script must survive a moody tunnel): persistent
-compile cache, a watchdog around backend init that fails fast with a
-diagnostic JSON line instead of hanging, and a result line even if only a
-single timed chain completes. The round-3 lesson (BENCH_r03.json captured
-a CPU fallback because two 75 s probes hit a multi-hour tunnel wedge): the
-tunnel can wedge at ANY point, including mid-bench, and a wedged PJRT call
-hangs the process uninterruptibly. So the orchestrator in this process
-never touches the backend at all:
+  1. DETECT: probe PBFT_VERIFY_SERVICE (default 127.0.0.1:7600) with a
+     short deadline. A ready service is driven over the 128-byte-triple
+     protocol from several coalescing connections — ZERO timed seconds
+     on backend init or compile; cold/warm startup costs are read from
+     the service's status and reported separately.
+  2. LAUNCH-ONCE: no service but accelerator indicators present (or
+     PBFT_BENCH_LAUNCH_SERVICE=1) -> spawn verifyd, wait for readiness
+     under PBFT_SERVICE_WARM_BUDGET_S (the once-per-deploy cold start,
+     paid OUTSIDE the timed region), bench it, stop it. A wedged PJRT
+     tunnel costs one bounded wait — the old 8 x 60 s in-process probe
+     loop (BENCH_r05's 480 s tax) is gone.
+  3. FALLBACK: otherwise measure the framework's production CPU arm
+     (native C++ pool; XLA:CPU as last resort) and tag the result
+     "cpu-native-fallback" / "cpu-fallback" — a real number, never 0.0.
 
-  1. PROBE: `jax.devices()` in disposable subprocesses — default 8
-     attempts x 60 s with backoff gaps between them (~13 min worst
-     case, well inside the driver budget).
-  2. RUN: the whole TPU bench (backend init, compile, timed region) runs
-     in a KILLABLE WORKER SUBPROCESS (`bench.py --tpu-worker`) under a
-     timeout; a mid-bench wedge kills the worker and the orchestrator
-     re-probes and retries instead of dying.
-  3. FALLBACK: only after the full probe+retry budget is spent does it
-     fall back to the framework's CPU verifier arm (native C++ Ed25519
-     when built, else XLA:CPU at a small batch) and report a real
-     measured number tagged "backend": "cpu-native-fallback" /
-     "cpu-fallback" instead of a useless 0.0 artifact.
+Methodology, service arm: the timed region counts verdict bytes returned
+for submitted windows (request -> merged coalesced window -> sharded XLA
+launch -> per-connection verdict slices), after one untimed warmup
+round-trip per connection. The service's own verify is data-dependent
+per item; verdict bitmaps are validated against the known-planted
+invalid signature. In-process XLA arms (PBFT_BENCH_CPU / --tpu-worker)
+keep the chained-jit methodology: K kernel applications chained inside
+one jit so async dispatch and launch caching cannot fake the number.
 
 Baseline for vs_baseline: the reference publishes no numbers and does not
 compile (SURVEY.md §6); BASELINE.json's target is >= 50,000 verifies/sec on
 one TPU host, so vs_baseline = value / 50_000.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline",
-"backend"[, "note", "error"]}.
+"backend"[, "devices", "note", "error", ...]}.
 """
 
 from __future__ import annotations
@@ -125,17 +122,14 @@ def _force_cpu() -> None:
 def _probe_tpu(
     timeout_s: float, attempts: int, gap_s: float, budget_s: float | None = None
 ) -> bool:
-    """Probe TPU backend init in disposable subprocesses.
+    """One-shot TPU reachability probe in disposable subprocesses.
 
-    A wedged tunnel hangs ``jax.devices()`` beyond any in-process watchdog's
-    ability to clean up (the probe thread leaks, and a second in-process
-    attempt just queues behind the same wedged client init). Subprocesses
-    are killable, and a tunnel that is merely slow/mid-restart often comes
-    back between attempts.
-
-    ``budget_s`` caps the WHOLE probe loop (attempts + backoff gaps): the
-    BENCH_r05 lesson was 8 x 60 s of probing before the inevitable CPU
-    fallback — a dead tunnel should cost minutes, not the round's budget.
+    No longer part of bench.py's own flow (the verify service's readiness
+    handshake replaced the in-bench probe loop, ISSUE 7) — kept for the
+    round-long watchers (scripts/tpu_watch.py, scripts/tpu_evidence.py)
+    that poll for tunnel windows across a whole round. A wedged tunnel
+    hangs ``jax.devices()`` beyond any in-process watchdog; subprocesses
+    are killable.
     """
     import subprocess
 
@@ -143,32 +137,19 @@ def _probe_tpu(
     gap = gap_s
     loop_t0 = time.perf_counter()
     for attempt in range(1, attempts + 1):
-        if budget_s is not None:
-            spent = time.perf_counter() - loop_t0
-            if spent >= budget_s:
-                _log(
-                    f"tpu probe: budget {budget_s:.0f}s exhausted after "
-                    f"{attempt - 1} attempts ({spent:.0f}s)"
-                )
-                return False
+        if budget_s is not None and time.perf_counter() - loop_t0 >= budget_s:
+            _log(f"tpu probe: budget {budget_s:.0f}s exhausted")
+            return False
         t0 = time.perf_counter()
-        attempt_timeout = timeout_s
-        if budget_s is not None:
-            attempt_timeout = min(
-                timeout_s, max(5.0, budget_s - (time.perf_counter() - loop_t0))
-            )
         try:
             out = subprocess.run(
                 [sys.executable, "-c", code],
                 capture_output=True,
                 text=True,
-                timeout=attempt_timeout,
+                timeout=timeout_s,
             )
         except subprocess.TimeoutExpired:
-            _log(
-                f"tpu probe {attempt}/{attempts}: timeout after "
-                f"{attempt_timeout:.0f}s"
-            )
+            _log(f"tpu probe {attempt}/{attempts}: timeout after {timeout_s:.0f}s")
             out = None
         if out is not None and out.returncode == 0:
             info = out.stdout.strip()
@@ -195,13 +176,13 @@ def _probe_tpu(
 def _tpu_indicators() -> list:
     """Environment signals that a TPU could plausibly be reachable.
 
-    The probe loop exists for a tunnel that might come back; when the
-    environment already rules a chip out (no accelerator device nodes, no
-    tunnel/proxy configuration), 8 x 60 s of probing just delays the
-    inevitable CPU fallback (the BENCH_r05 lesson: 480 s spent learning
-    what the environment already said). A bare libtpu *module* is not an
-    indicator — the image bakes it in everywhere; without device nodes it
-    cannot drive anything.
+    A service launch only makes sense when a chip might exist; when the
+    environment already rules one out (no accelerator device nodes, no
+    tunnel/proxy configuration), spinning up a JAX service just delays
+    the inevitable CPU fallback (the BENCH_r05 lesson: 480 s of probing
+    that the environment had already answered). A bare libtpu *module*
+    is not an indicator — the image bakes it in everywhere; without
+    device nodes it cannot drive anything.
     """
     import glob
 
@@ -369,51 +350,189 @@ def _native_fallback(
     return True
 
 
-def _run_worker(timeout_s: float) -> dict | None:
-    """Run the full TPU bench in a killable subprocess.
+def _service_target() -> str:
+    return os.environ.get("PBFT_VERIFY_SERVICE", "127.0.0.1:7600")
 
-    Returns the worker's JSON result dict, or None when the worker wedged
-    (killed at timeout) or produced no parseable result line. The worker's
-    stderr is inherited so its progress lands in this process's stderr.
+
+def _probe_service(target: str) -> dict | None:
+    """Short-deadline JSON status probe of a running verify service."""
+    from pbft_tpu.net.verify_service import probe_status_json
+
+    return probe_status_json(target, timeout=2.0)
+
+
+def _launch_service(budget_s: float):
+    """Spawn verifyd ONCE and wait (bounded) for readiness.
+
+    This is the once-per-deploy cold start — backend init + the pad
+    ladder's AOT warmup — paid entirely OUTSIDE the timed region. A
+    wedged PJRT tunnel costs exactly ``budget_s`` before the kill and
+    CPU fallback (the whole 8 x 60 s probe loop this replaces).
+
+    Returns (proc, target, status, cold_start_s) with proc=None on
+    failure (the subprocess is killed before returning).
     """
+    import socket
     import subprocess
 
-    proc = subprocess.Popen(
-        [sys.executable, os.path.abspath(__file__), "--tpu-worker"],
-        stdout=subprocess.PIPE,
-        text=True,
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    target = f"127.0.0.1:{port}"
+    cmd = [
+        sys.executable,
+        os.path.join(_REPO, "scripts", "verifyd.py"),
+        "--port",
+        str(port),
+        "--backend",
+        "jax",
+    ]
+    _log(f"launching verify service: {' '.join(cmd)}")
+    # stdout is OURS for the one result line: the daemon's announcements
+    # go to stderr-land (devnull; its warnings inherit our stderr).
+    proc = subprocess.Popen(cmd, stdout=subprocess.DEVNULL)
+    from pbft_tpu.net.verify_service import probe_status_json
+
+    t0 = time.perf_counter()
+    status = None
+    while time.perf_counter() - t0 < budget_s:
+        if proc.poll() is not None:
+            _log(f"verify service exited rc={proc.returncode} during warmup")
+            return None, target, None, 0.0
+        status = probe_status_json(target, timeout=2.0)
+        if status is not None and status.get("state") == "ready":
+            cold = time.perf_counter() - t0
+            _log(f"verify service ready in {cold:.1f}s: {status}")
+            return proc, target, status, cold
+        if status is not None and status.get("state") == "cpu-only":
+            # The daemon found no usable accelerator (warm_error says
+            # why); its CPU arm would only re-measure our own fallback
+            # with a socket in the middle.
+            _log(f"verify service came up cpu-only: {status}")
+            break
+        time.sleep(2.0)
+    _stop_service(proc)
+    _log(
+        f"verify service not ready after {time.perf_counter() - t0:.0f}s; "
+        "killed"
     )
+    return None, target, None, 0.0
+
+
+def _stop_service(proc) -> None:
+    if proc is None or proc.poll() is not None:
+        return
+    proc.terminate()
     try:
-        out, _ = proc.communicate(timeout=timeout_s)
-    except subprocess.TimeoutExpired:
+        proc.wait(timeout=10)
+    except Exception:  # noqa: BLE001 - wedged teardown
         proc.kill()
-        try:
-            out, _ = proc.communicate(timeout=15)
-        except subprocess.TimeoutExpired:  # pragma: no cover - unkillable child
-            out = ""
-        _log(f"tpu worker: killed after {timeout_s:.0f}s")
-        # A worker that printed its result and THEN wedged in teardown
-        # (interpreter-exit PJRT cleanup over a dead tunnel) still counts:
-        # don't throw away a completed measurement.
-        result = _parse_result(out)
-        if result is not None:
-            _log("tpu worker: result line recovered from killed worker")
-        return result
-    result = _parse_result(out)
-    if result is None:
-        _log(f"tpu worker: rc={proc.returncode}, no JSON result line")
-    return result
 
 
-def _parse_result(out: str | None) -> dict | None:
-    for line in reversed((out or "").strip().splitlines()):
-        line = line.strip()
-        if line.startswith("{"):
+def _run_service_bench(
+    target: str, status: dict, target_secs: float, cold_start_s: float | None
+) -> None:
+    """Drive a ready verify service: several connections submit windows
+    concurrently (the coalescing dispatcher merges them into sharded XLA
+    launches), timed AFTER one untimed warmup round-trip per connection —
+    zero timed seconds on backend init or compile."""
+    import socket
+
+    batch = int(os.environ.get("PBFT_BENCH_BATCH", "1024"))
+    conns = int(os.environ.get("PBFT_BENCH_SERVICE_CONNS", "4"))
+    # Per-roundtrip socket deadline: generous (a warmed TPU launch is
+    # milliseconds; XLA:CPU control arms take seconds per window).
+    io_timeout = float(os.environ.get("PBFT_BENCH_SERVICE_TIMEOUT", "300"))
+    bp, bm, bs = _signed_pool(batch)
+    payload = (batch).to_bytes(4, "big") + b"".join(
+        bytes(bp[i]) + bytes(bm[i]) + bytes(bs[i]) for i in range(batch)
+    )
+    host, port = target.rsplit(":", 1)
+
+    def roundtrip(sock) -> int:
+        sock.sendall(payload)
+        got = 0
+        while got < batch:
+            chunk = sock.recv(batch - got)
+            if not chunk:
+                raise ConnectionError("service closed mid-verdicts")
+            got += len(chunk)
+        return got
+
+    socks = []
+    try:
+        t0 = time.perf_counter()
+        for _ in range(conns):
+            sock = socket.create_connection(
+                (host, int(port)), timeout=io_timeout
+            )
+            # Warmup round-trip: validates the verdict bitmap end to end
+            # and keeps connect + first-window effects out of the timed
+            # region. (The service compiled at startup; this is not a
+            # compile, just the pipeline filling.)
+            sock.sendall(payload)
+            out = b""
+            while len(out) < batch:
+                chunk = sock.recv(batch - len(out))
+                if not chunk:
+                    raise ConnectionError("service closed during warmup")
+                out += chunk
+            if sum(out) != batch - 1 or out[batch // 2]:
+                _fail("service-verdicts", f"wrong bitmap: sum={sum(out)}")
+            socks.append(sock)
+        warm_start_s = time.perf_counter() - t0
+        _log(f"service warm-start ({conns} conns): {warm_start_s:.2f}s")
+
+        done = [0] * conns
+        errors: list = []
+        stop_at = time.perf_counter() + target_secs
+
+        def worker(idx: int, sock) -> None:
             try:
-                return json.loads(line)
-            except ValueError:
-                continue
-    return None
+                while time.perf_counter() < stop_at or done[idx] == 0:
+                    done[idx] += roundtrip(sock)
+            except Exception as e:  # noqa: BLE001 - reported below
+                errors.append(repr(e))
+
+        t0 = time.perf_counter()
+        threads = [
+            threading.Thread(target=worker, args=(i, s), daemon=True)
+            for i, s in enumerate(socks)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=target_secs * 10 + 120)
+        elapsed = time.perf_counter() - t0
+        if errors:
+            _fail("service-timed-region", "; ".join(errors[:3]))
+        per_sec = sum(done) / elapsed
+    finally:
+        for sock in socks:
+            try:
+                sock.close()
+            except OSError:
+                pass
+    warm_stats = status.get("warm_stats", {})
+    extra = {
+        "devices": status.get("devices", 0),
+        "service_state": status.get("state"),
+        "connections": conns,
+        "batch": batch,
+        "warm_start_s": round(warm_start_s, 3),
+        "steady_state_per_sec": round(per_sec, 1),
+        "service_cold_compile_s": warm_stats.get("cold_compile_s"),
+        "service_warm_load_s": warm_stats.get("warm_load_s"),
+    }
+    if cold_start_s is not None:
+        # We launched the service this run: spawn -> ready wall time
+        # (backend init + warmup), paid once per deploy, never timed.
+        extra["cold_start_s"] = round(cold_start_s, 1)
+    _log(
+        f"service steady state: {per_sec:.0f} verifies/sec over "
+        f"{conns} connections ({elapsed:.2f}s timed)"
+    )
+    _emit(per_sec, "verify-service", None, extra=extra)
 
 
 def main() -> None:
@@ -457,58 +576,63 @@ def main() -> None:
             )
         )
         return
-    if os.environ.get("PBFT_BENCH_CPU") or os.environ.get("JAX_PLATFORMS") == "cpu":
+    if (
+        os.environ.get("PBFT_BENCH_CPU")
+        and "PBFT_VERIFY_SERVICE" not in os.environ
+    ):
+        # Explicit in-process XLA:CPU arm (kernel-on-XLA:CPU control; the
+        # chained-jit compile alone is minutes at the default batch). An
+        # EXPLICIT service target wins even here: operators with a warmed
+        # service still get the zero-compile timed region. A cpu-pinned
+        # shell (JAX_PLATFORMS=cpu) is NOT routed here — it means "no
+        # accelerator", and the production CPU arm below (native pool)
+        # is the honest fast measurement for that environment.
         os.environ["JAX_PLATFORMS"] = "cpu"
         _force_cpu()
         _run_xla_bench("cpu", None, target_secs)
         return
 
-    # TPU path: probe in disposable subprocesses, then run the bench in a
-    # killable worker; retry (with a short re-probe) if the worker wedges.
-    # PBFT_TPU_PROBE_BUDGET_S caps the whole probe loop (BENCH_r05 burned
-    # 8 x 60 s before the inevitable fallback) — and, when set explicitly,
-    # forces probing even where the environment shows no chip indicators.
-    probe_budget_env = os.environ.get("PBFT_TPU_PROBE_BUDGET_S")
-    probe_budget = float(probe_budget_env or "240")
-    indicators = _tpu_indicators()
-    if not indicators and probe_budget_env is None:
-        _log(
-            "tpu probe: skipped entirely — no accelerator device nodes or "
-            "tunnel indicators in the environment (set "
-            "PBFT_TPU_PROBE_BUDGET_S to force probing)"
-        )
-        probed = False
+    # Accelerator path (ISSUE 7): a persistent verify service owns the
+    # chip. Detect a running one first (zero startup cost in this run);
+    # else launch one ONCE when the environment suggests a chip could
+    # exist (or PBFT_BENCH_LAUNCH_SERVICE=1 forces it), with the whole
+    # cold start bounded by PBFT_SERVICE_WARM_BUDGET_S and paid outside
+    # the timed region. No in-process probe loop in either case.
+    target = _service_target()
+    status = _probe_service(target)
+    proc, cold_start_s = None, None
+    if status is None:
+        # A cpu-pinned shell rules an accelerator out up front: don't
+        # spin up a JAX service just to discover CpuDevice (the engine
+        # would then sink minutes into XLA:CPU ladder compiles).
+        cpu_pinned = os.environ.get("JAX_PLATFORMS") == "cpu"
+        indicators = [] if cpu_pinned else _tpu_indicators()
+        if indicators or os.environ.get("PBFT_BENCH_LAUNCH_SERVICE"):
+            if indicators:
+                _log(f"tpu indicators: {', '.join(indicators)}")
+            budget = float(os.environ.get("PBFT_SERVICE_WARM_BUDGET_S", "900"))
+            proc, target, status, cold_start_s = _launch_service(budget)
+        else:
+            why = (
+                "shell pins JAX_PLATFORMS=cpu"
+                if cpu_pinned
+                else "no accelerator indicators"
+            )
+            _log(
+                f"verify service: none reachable and {why} — native CPU "
+                "fallback (set PBFT_BENCH_LAUNCH_SERVICE=1 to force a "
+                "service launch)"
+            )
     else:
-        if indicators:
-            _log(f"tpu indicators: {', '.join(indicators)}")
-        probed = _probe_tpu(
-            timeout_s=float(os.environ.get("PBFT_BENCH_PROBE_TIMEOUT", "60")),
-            attempts=int(os.environ.get("PBFT_BENCH_PROBES", "8")),
-            gap_s=float(os.environ.get("PBFT_BENCH_PROBE_GAP", "10")),
-            budget_s=probe_budget,
-        )
-    if probed:
-        worker_timeout = float(os.environ.get("PBFT_BENCH_WORKER_TIMEOUT", "600"))
-        tpu_attempts = int(os.environ.get("PBFT_BENCH_TPU_ATTEMPTS", "3"))
-        for attempt in range(1, tpu_attempts + 1):
-            result = _run_worker(worker_timeout)
-            if result and not result.get("error") and result.get("value", 0) > 0:
-                print(json.dumps(result))
-                return
-            _log(f"tpu worker attempt {attempt}/{tpu_attempts} failed: {result}")
-            # Only transient failures (wedge-kill -> None, or backend init
-            # trouble) are worth a retry; a deterministic in-bench error
-            # (wrong verdicts, kernel exception) will just fail identically
-            # two more expensive times.
-            err = (result or {}).get("error", "")
-            if result is not None and not err.startswith("backend-init"):
-                break
-            if attempt < tpu_attempts and not _probe_tpu(
-                timeout_s=60.0, attempts=3, gap_s=15.0,
-                budget_s=min(90.0, probe_budget),
-            ):
-                break
-    fallback_reason = "tpu bench never completed; CPU fallback"
+        _log(f"verify service at {target}: {status}")
+    if status is not None and status.get("state") in ("ready", "cpu-only"):
+        try:
+            _run_service_bench(target, status, target_secs, cold_start_s)
+            return
+        finally:
+            _stop_service(proc)
+    _stop_service(proc)
+    fallback_reason = "no ready verify service; CPU fallback"
     # If the round-long watcher (scripts/tpu_watch.py) already captured an
     # on-chip kernel number during a tunnel window, point the artifact's
     # note at it: the fallback VALUE stays the honest live measurement,

@@ -631,6 +631,93 @@ void test_remote_verifier_async() {
   ::close(sv2[1]);
 }
 
+void test_remote_verifier_readiness() {
+  // The verify-service readiness handshake (ISSUE 7): parse the 8-byte
+  // status record, defer to the fallback while warming, use the service
+  // once ready, and assume a silent pre-handshake service is ready.
+  ::setenv("PBFT_VERIFY_PROBE_MS", "50", 1);
+  auto pack = [](uint8_t state, uint16_t devices, uint16_t warmed) {
+    return std::vector<uint8_t>{'V',
+                                'S',
+                                1,
+                                state,
+                                (uint8_t)(devices >> 8),
+                                (uint8_t)devices,
+                                (uint8_t)(warmed >> 8),
+                                (uint8_t)warmed};
+  };
+  {
+    int sv[2];
+    CHECK(::socketpair(AF_UNIX, SOCK_STREAM, 0, sv) == 0);
+    pbft::RemoteVerifier rv("/unused");
+    rv.adopt_fd_for_test(sv[0]);
+    auto warming = pack(0, 8, 5);
+    CHECK(write(sv[1], warming.data(), warming.size()) == 8);
+    CHECK(rv.probe_status_for_test());
+    CHECK(rv.service_state() ==
+          pbft::RemoteVerifier::ServiceState::kWarming);
+    CHECK(rv.service_devices() == 8);
+    // Warming -> begin_batch refuses (the event loop's CPU safety net
+    // carries the batch); the embedded reprobe times out against the
+    // silent socketpair and the connection drops.
+    std::vector<pbft::VerifyItem> items(1);
+    std::memset(items[0].pub, 1, 32);
+    CHECK(!rv.begin_batch(items));
+    CHECK(rv.async_fd() == -1);
+    ::close(sv[1]);
+  }
+  {
+    int sv[2];
+    CHECK(::socketpair(AF_UNIX, SOCK_STREAM, 0, sv) == 0);
+    pbft::RemoteVerifier rv("/unused");
+    rv.adopt_fd_for_test(sv[0]);
+    auto ready = pack(1, 4, 5);
+    CHECK(write(sv[1], ready.data(), ready.size()) == 8);
+    CHECK(rv.probe_status_for_test());
+    CHECK(rv.service_state() == pbft::RemoteVerifier::ServiceState::kReady);
+    CHECK(rv.service_devices() == 4);
+    // Ready -> batches ship (the probe's own 4-byte request is still in
+    // the socketpair; drain it before the batch frame).
+    std::vector<pbft::VerifyItem> items(1);
+    std::memset(items[0].pub, 7, 32);
+    CHECK(rv.begin_batch(items));
+    uint8_t buf[4 + 4 + 128];  // probe + framed 1-item batch
+    CHECK(read(sv[1], buf, sizeof(buf)) == (ssize_t)sizeof(buf));
+    CHECK(buf[7] == 1 && buf[8] == 7);
+    ::close(sv[1]);
+  }
+  {
+    // cpu-only: usable (a CPU service still coalesces colocated daemons).
+    int sv[2];
+    CHECK(::socketpair(AF_UNIX, SOCK_STREAM, 0, sv) == 0);
+    pbft::RemoteVerifier rv("/unused");
+    rv.adopt_fd_for_test(sv[0]);
+    auto cpu = pack(2, 0, 0);
+    CHECK(write(sv[1], cpu.data(), cpu.size()) == 8);
+    CHECK(rv.probe_status_for_test());
+    CHECK(rv.service_state() ==
+          pbft::RemoteVerifier::ServiceState::kCpuOnly);
+    std::vector<pbft::VerifyItem> items(1);
+    CHECK(rv.begin_batch(items));
+    ::close(sv[1]);
+  }
+  {
+    // Legacy service: no status reply -> assumed ready after the (short)
+    // probe deadline; garbage -> probe fails.
+    int sv[2];
+    CHECK(::socketpair(AF_UNIX, SOCK_STREAM, 0, sv) == 0);
+    pbft::RemoteVerifier rv("/unused");
+    rv.adopt_fd_for_test(sv[0]);
+    CHECK(rv.probe_status_for_test(/*allow_legacy=*/true));
+    CHECK(rv.service_state() == pbft::RemoteVerifier::ServiceState::kReady);
+    uint8_t garbage[8] = {'X', 'X', 9, 9, 0, 0, 0, 0};
+    CHECK(write(sv[1], garbage, 8) == 8);
+    CHECK(!rv.probe_status_for_test());
+    ::close(sv[1]);
+  }
+  ::unsetenv("PBFT_VERIFY_PROBE_MS");
+}
+
 }  // namespace
 
 int main() {
@@ -647,6 +734,7 @@ int main() {
   test_batch_verify_rlc();
   test_verify_pool_native();
   test_remote_verifier_async();
+  test_remote_verifier_readiness();
   if (g_failures) {
     std::fprintf(stderr, "%d failure(s)\n", g_failures);
     return 1;

@@ -32,6 +32,10 @@ const char* kCounterNames[] = {
     // Chaos surface (ISSUE 5): fault behaviors fired by --fault, frames
     // dropped by the seeded --chaos-drop-pct link knob.
     "pbft_faults_injected_total", "pbft_chaos_dropped_total",
+    // Verify-service surface (ISSUE 7): launches shipped by the
+    // coalescing dispatcher. Zero on a replica (eager registration keeps
+    // the series set uniform across every runtime's scrape).
+    "pbft_verify_service_launches_total",
 };
 const char* kGaugeNames[] = {
     "pbft_verify_queue_depth",
@@ -39,12 +43,19 @@ const char* kGaugeNames[] = {
     "pbft_verify_pool_threads",
     "pbft_verify_pool_queue_depth",
     "pbft_verify_pool_utilization",
+    // Verify-service warmup cost (ISSUE 7): once-per-deploy compile
+    // seconds, split cold (traced+compiled) vs warm (export/cache
+    // reload). Zero on a replica.
+    "pbft_verify_service_cold_compile_seconds",
+    "pbft_verify_service_warm_compile_seconds",
 };
 // name -> uses the size bucket ladder (else latency).
 const std::pair<const char*, bool> kHistogramNames[] = {
     {"pbft_verify_batch_size", true},
     {"pbft_verify_pool_window_size", true},
     {"pbft_batch_size", true},
+    {"pbft_verify_service_window_size", true},
+    {"pbft_verify_service_coalesced_clients", true},
     {"pbft_verify_seconds", false},
     {"pbft_phase_pre_prepare_seconds", false},
     {"pbft_phase_prepare_seconds", false},

@@ -1,12 +1,16 @@
 #include "verifier.h"
 
 #include <arpa/inet.h>
+#include <fcntl.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
+#include <poll.h>
 #include <sys/socket.h>
 #include <sys/un.h>
 #include <unistd.h>
 
+#include <cstdio>
+#include <cstdlib>
 #include <cstring>
 
 #include "ed25519.h"
@@ -37,34 +41,29 @@ size_t CpuVerifier::parallel_capacity() const {
   return (size_t)global_verify_pool().threads();
 }
 
-RemoteVerifier::RemoteVerifier(std::string target) : target_(std::move(target)) {}
+RemoteVerifier::RemoteVerifier(std::string target) : target_(std::move(target)) {
+  if (const char* e = std::getenv("PBFT_VERIFY_CONNECT_MS"))
+    connect_timeout_ms_ = std::atoi(e) > 0 ? std::atoi(e) : connect_timeout_ms_;
+  if (const char* e = std::getenv("PBFT_VERIFY_PROBE_MS"))
+    probe_timeout_ms_ = std::atoi(e) > 0 ? std::atoi(e) : probe_timeout_ms_;
+}
 
 RemoteVerifier::~RemoteVerifier() {
   if (fd_ >= 0) ::close(fd_);
 }
 
-bool RemoteVerifier::ensure_connected() {
-  if (fd_ >= 0) return true;
-  // Best-effort: a roomier send buffer widens the async write budget
-  // (the kernel clamps to wmem_max without privileges; harmless if so).
-  // The async item budget is then DERIVED from what the kernel actually
-  // granted — begin_batch's blocking write must always fit the buffer,
-  // or the event loop would stall for exactly the round-trip the async
-  // path exists to hide.
-  auto grow_sndbuf = [this](int fd) {
-    int want = 1 << 20;
-    ::setsockopt(fd, SOL_SOCKET, SO_SNDBUF, &want, sizeof(want));
-    int got = 0;
-    socklen_t len = sizeof(got);
-    if (::getsockopt(fd, SOL_SOCKET, SO_SNDBUF, &got, &len) == 0 && got > 0) {
-      // Linux reports the doubled value (bookkeeping overhead included);
-      // budget on half of it, minus the 4-byte header.
-      size_t payload = (size_t)got / 2;
-      async_budget_items_ = payload > 132 ? (payload - 4) / 128 : 1;
-      if (async_budget_items_ > 4096) async_budget_items_ = 4096;
-    }
-  };
+void RemoteVerifier::drop_connection() {
+  if (fd_ >= 0) ::close(fd_);
+  fd_ = -1;
+  inflight_ = false;
+  retry_after_ =
+      std::chrono::steady_clock::now() + std::chrono::milliseconds(reprobe_ms_);
+}
+
+bool RemoteVerifier::connect_with_deadline() {
   if (!target_.empty() && target_[0] == '/') {
+    // Unix-domain connect on the local host completes (or refuses)
+    // immediately; the listen backlog cannot blackhole it.
     fd_ = ::socket(AF_UNIX, SOCK_STREAM, 0);
     if (fd_ < 0) return false;
     sockaddr_un addr{};
@@ -75,12 +74,128 @@ bool RemoteVerifier::ensure_connected() {
       fd_ = -1;
       return false;
     }
-    grow_sndbuf(fd_);
     return true;
   }
-  fd_ = dial_tcp(target_);  // shared TCP dialer (net.cc)
-  if (fd_ >= 0) grow_sndbuf(fd_);
-  return fd_ >= 0;
+  bool in_progress = false;
+  fd_ = dial_tcp_nb(target_, &in_progress);  // shared dialer (net.cc)
+  if (fd_ < 0) return false;
+  if (in_progress) {
+    pollfd pfd{fd_, POLLOUT, 0};
+    if (::poll(&pfd, 1, connect_timeout_ms_) <= 0) {
+      ::close(fd_);
+      fd_ = -1;
+      return false;  // the short dial deadline: never stall the loop
+    }
+    int err = 0;
+    socklen_t len = sizeof(err);
+    if (::getsockopt(fd_, SOL_SOCKET, SO_ERROR, &err, &len) != 0 || err != 0) {
+      ::close(fd_);
+      fd_ = -1;
+      return false;
+    }
+  }
+  // The request/verdict exchange uses blocking writes/reads sized to the
+  // send-buffer budget; restore blocking mode after the probing connect.
+  int flags = ::fcntl(fd_, F_GETFL, 0);
+  if (flags >= 0) ::fcntl(fd_, F_SETFL, flags & ~O_NONBLOCK);
+  return true;
+}
+
+bool RemoteVerifier::probe_status(bool allow_legacy) {
+  // Count-0 status probe (pbft_tpu/net/service.py pack_status): 8 bytes
+  // 'V' 'S' version state u16be devices u16be warmed-shapes.
+  const uint8_t probe[4] = {0, 0, 0, 0};
+  if (::send(fd_, probe, 4, MSG_NOSIGNAL) != 4) return false;
+  uint8_t status[8];
+  size_t got = 0;
+  while (got < sizeof(status)) {
+    pollfd pfd{fd_, POLLIN, 0};
+    int r = ::poll(&pfd, 1, probe_timeout_ms_);
+    if (r <= 0) {
+      if (got == 0 && allow_legacy) {
+        // A pre-handshake service never answers count 0 (it maps to an
+        // empty batch with an empty reply): assume ready, keep the link,
+        // and remember — later re-dials to this target must not stall
+        // the event loop for another probe deadline.
+        legacy_ = true;
+        state_ = ServiceState::kReady;
+        devices_ = 0;
+        warmed_ = 0;
+        return true;
+      }
+      return false;  // wedged, or died mid-status
+    }
+    ssize_t n = ::recv(fd_, status + got, sizeof(status) - got, 0);
+    if (n <= 0) return false;
+    got += (size_t)n;
+  }
+  if (status[0] != 'V' || status[1] != 'S' || status[2] != 1 || status[3] > 2)
+    return false;
+  ServiceState prev = state_;
+  state_ = status[3] == 0   ? ServiceState::kWarming
+           : status[3] == 1 ? ServiceState::kReady
+                            : ServiceState::kCpuOnly;
+  devices_ = (status[4] << 8) | status[5];
+  warmed_ = (status[6] << 8) | status[7];
+  if (state_ != prev) {
+    const char* names[] = {"unknown", "warming", "ready", "cpu-only"};
+    std::fprintf(stderr, "[verifier] service %s: %s (%d devices, %d shapes)\n",
+                 target_.c_str(), names[(int)state_], devices_, warmed_);
+  }
+  return true;
+}
+
+bool RemoteVerifier::ensure_connected() {
+  auto now = std::chrono::steady_clock::now();
+  if (fd_ >= 0) {
+    if (state_ != ServiceState::kWarming) return true;
+    // Warming: the connection is good but the accelerator isn't — ask
+    // again at the reprobe cadence, serving from the fallback meanwhile.
+    if (now < retry_after_) return false;
+    retry_after_ = now + std::chrono::milliseconds(reprobe_ms_);
+    if (!probe_status(/*allow_legacy=*/false)) {
+      drop_connection();
+      return false;
+    }
+    return state_ != ServiceState::kWarming;
+  }
+  if (now < retry_after_) return false;
+  if (!connect_with_deadline()) {
+    retry_after_ = now + std::chrono::milliseconds(reprobe_ms_);
+    return false;
+  }
+  // Best-effort: a roomier send buffer widens the async write budget
+  // (the kernel clamps to wmem_max without privileges; harmless if so).
+  // The async item budget is then DERIVED from what the kernel actually
+  // granted — begin_batch's blocking write must always fit the buffer,
+  // or the event loop would stall for exactly the round-trip the async
+  // path exists to hide.
+  int want = 1 << 20;
+  ::setsockopt(fd_, SOL_SOCKET, SO_SNDBUF, &want, sizeof(want));
+  int got = 0;
+  socklen_t len = sizeof(got);
+  if (::getsockopt(fd_, SOL_SOCKET, SO_SNDBUF, &got, &len) == 0 && got > 0) {
+    // Linux reports the doubled value (bookkeeping overhead included);
+    // budget on half of it, minus the 4-byte header.
+    size_t payload = (size_t)got / 2;
+    async_budget_items_ = payload > 132 ? (payload - 4) / 128 : 1;
+    if (async_budget_items_ > 4096) async_budget_items_ = 4096;
+  }
+  if (legacy_) {
+    // Known pre-handshake target: the probe deadline was paid once on
+    // the first dial; treat every reconnect as ready immediately.
+    state_ = ServiceState::kReady;
+    return true;
+  }
+  if (!probe_status(/*allow_legacy=*/true)) {
+    drop_connection();
+    return false;
+  }
+  if (state_ == ServiceState::kWarming) {
+    retry_after_ = now + std::chrono::milliseconds(reprobe_ms_);
+    return false;
+  }
+  return true;
 }
 
 static bool write_all(int fd, const uint8_t* data, size_t n) {
@@ -137,8 +252,9 @@ std::vector<uint8_t> RemoteVerifier::verify_batch(
   std::vector<uint8_t> out(items.size());
   if (!write_all(fd_, buf.data(), buf.size()) ||
       !read_all(fd_, out.data(), out.size())) {
-    ::close(fd_);
-    fd_ = -1;
+    // Killed mid-stream: drop the link (with reconnect backoff) and
+    // verify THIS batch on the native pool — the liveness contract.
+    drop_connection();
     return fallback_.verify_batch(items);
   }
   return out;
@@ -153,8 +269,7 @@ bool RemoteVerifier::begin_batch(const std::vector<VerifyItem>& items) {
   if (items.size() > async_budget_items_) return false;
   auto buf = encode_request(items);
   if (!write_all(fd_, buf.data(), buf.size())) {
-    ::close(fd_);
-    fd_ = -1;
+    drop_connection();
     return false;
   }
   inflight_ = true;
@@ -195,10 +310,8 @@ bool RemoteVerifier::poll_result(std::vector<uint8_t>* out, bool* failed) {
       return false;  // more verdicts still on the wire; poll again
     }
     // EOF or error mid-batch: the service died — hand the batch back to
-    // the caller's fallback.
-    ::close(fd_);
-    fd_ = -1;
-    inflight_ = false;
+    // the caller's fallback (and back off reconnecting).
+    drop_connection();
     *failed = true;
     return true;
   }

@@ -16,8 +16,19 @@
 //   Protocol: u32be count, then count * (32+32+64) bytes; reply = count
 //   bytes of 0/1. Falls back to CPU when the service is unreachable so a
 //   verifier outage degrades throughput, not safety/liveness.
+//   Readiness handshake (ISSUE 7, pbft_tpu/net/verify_service.py): the
+//   dial uses a SHORT connect deadline, then a count-0 status probe
+//   returns 8 bytes ('V' 'S' version state u16be devices u16be warmed
+//   shapes). state warming -> this verifier reports unusable and the
+//   caller's fallback (the PR-2 native verify pool) carries the traffic,
+//   re-probing at a gentle cadence until the service reports ready — a
+//   cold accelerator can never block consensus. state ready / cpu-only
+//   -> the service is used (a cpu-only service still coalesces windows
+//   across every colocated daemon). A legacy service that never answers
+//   the probe is assumed ready after the probe deadline.
 #pragma once
 
+#include <chrono>
 #include <cstdint>
 #include <string>
 #include <vector>
@@ -90,11 +101,44 @@ class RemoteVerifier : public Verifier {
   // Test hook: adopt an already-connected fd (e.g. a socketpair end).
   void adopt_fd_for_test(int fd) { fd_ = fd; }
 
+  // Last observed readiness-handshake result (kUnknown before any
+  // successful dial). Matches pbft_tpu/net/service.py STATE_* values.
+  enum class ServiceState { kUnknown, kWarming, kReady, kCpuOnly };
+  ServiceState service_state() const { return state_; }
+  int service_devices() const { return devices_; }
+  // Test hook: run the status probe/parse on an adopted fd.
+  bool probe_status_for_test(bool allow_legacy = false) {
+    return probe_status(allow_legacy);
+  }
+
  private:
   bool ensure_connected();
+  // Non-blocking connect bounded by connect_timeout_ms_ (a downed or
+  // blackholed service must cost milliseconds, not an OS connect
+  // timeout, on the consensus event loop's verify path).
+  bool connect_with_deadline();
+  // allow_legacy: a probe timeout right after connect means a
+  // pre-handshake service (assume ready); on a warming reprobe it means
+  // a wedged service (drop and re-dial later).
+  bool probe_status(bool allow_legacy);
+  void drop_connection();
   std::string target_;
   int fd_ = -1;
   CpuVerifier fallback_;
+  ServiceState state_ = ServiceState::kUnknown;
+  // Target answered no status probe once (pre-handshake service):
+  // assumed ready, and reconnects skip the probe deadline entirely so a
+  // deadline-dropped link never re-stalls the consensus event loop.
+  bool legacy_ = false;
+  int devices_ = 0;
+  int warmed_ = 0;
+  int connect_timeout_ms_ = 250;   // PBFT_VERIFY_CONNECT_MS
+  int probe_timeout_ms_ = 1000;    // PBFT_VERIFY_PROBE_MS
+  int reprobe_ms_ = 1000;          // warming/down re-check cadence
+  // Backoff stamp: no connect/probe attempts before this instant, so a
+  // dead or warming service costs at most one short probe per second
+  // instead of one per verify window.
+  std::chrono::steady_clock::time_point retry_after_{};
   // One batch in flight at a time (the service pairs one reply per
   // request on the connection, in order).
   bool inflight_ = false;

@@ -117,6 +117,34 @@ def _host_verify(pub: bytes, msg: bytes, sig: bytes) -> bool:
     return _HOST_VERIFIER(pub, msg, sig)
 
 
+_HOST_BATCH_VERIFIER = None
+
+
+def host_batch_verify(items):
+    """THE local batch-verify arm: the PR-2 native verify pool when the
+    C++ core is built (core/verify_pool.cc, fixed RLC windows across
+    threads), else the pure-Python oracle — identical accept sets either
+    way. This is the safety net every remote-verifier path degrades to:
+    a replica that dials a verify service (net/verify_service.py) and
+    finds it warming, unreachable, or dead mid-stream verifies the same
+    window here instead, so a cold accelerator can never block consensus.
+    ``items`` are (pub32, digest32, sig64) triples as produced by
+    :meth:`Replica.pending_items`; returns one bool per item."""
+    global _HOST_BATCH_VERIFIER
+    if _HOST_BATCH_VERIFIER is None:
+        _HOST_BATCH_VERIFIER = lambda batch: [  # noqa: E731 - cached lambda
+            crypto.verify(p, m, s) for p, m, s in batch
+        ]
+        try:
+            from .. import native
+
+            if native.available():
+                _HOST_BATCH_VERIFIER = native.verify_batch
+        except Exception:  # pragma: no cover - unbuilt native core
+            pass
+    return _HOST_BATCH_VERIFIER(items)
+
+
 def default_app(operation: str, seq: int) -> str:
     """The reference's execution is a no-op with a hardcoded result
     (reference src/message.rs:70); kept as the default app."""

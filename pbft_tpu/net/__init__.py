@@ -4,6 +4,11 @@
 - ``service``   — the JAX/TPU verifier service: the socket server the C++
   ``pbftd`` ships signature batches to (core/verifier.h RemoteVerifier);
   one vmap'd XLA launch per batch, coalesced across daemons.
+- ``verify_service`` — the persistent multi-chip daemon around it: owns
+  the accelerator, AOT-warms every pad-ladder window shape at startup,
+  answers the readiness handshake, and shards each merged window across
+  all local devices; plus the replica-side ``ServiceVerifier`` client
+  (short connect deadline, native-pool fallback).
 - ``secure``    — encrypted replica links + protocol versioning
   (signed-ephemeral-DH handshake, keyed-BLAKE2b AEAD; mirror of
   core/secure.cc — the reference's Noise-secured development_transport,
@@ -22,11 +27,23 @@ from .client import PbftClient
 from .launcher import LocalCluster, pbftd_path
 from .secure import PROTOCOL_VERSION, SecureChannel
 from .service import VerifierService
+from .verify_service import (
+    ServiceVerifier,
+    ShardedVerifyEngine,
+    VerifyServiceDaemon,
+    probe_status,
+    probe_status_json,
+)
 
 __all__ = [
     "PbftClient",
     "LocalCluster",
     "VerifierService",
+    "VerifyServiceDaemon",
+    "ShardedVerifyEngine",
+    "ServiceVerifier",
+    "probe_status",
+    "probe_status_json",
     "SecureChannel",
     "PROTOCOL_VERSION",
     "pbftd_path",

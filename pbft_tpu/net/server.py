@@ -42,7 +42,14 @@ from ..consensus.messages import (
     to_binary,
     with_sig,
 )
-from ..consensus.replica import Broadcast, Replica, Reply, Send, _host_sign
+from ..consensus.replica import (
+    Broadcast,
+    Replica,
+    Reply,
+    Send,
+    _host_sign,
+    host_batch_verify,
+)
 from ..utils import ConsensusSpans, MetricsRegistry, get_tracer, start_metrics_server
 from . import secure
 
@@ -179,6 +186,7 @@ class AsyncReplicaServer:
         # pbft_requests_executed_total / pbft_consensus_rounds_total deltas.
         self._seen_executed = 0
         self._seen_rounds = 0
+        self.service_verifier = None
         if callable(verifier):
             self.verify = verifier
         elif verifier == "jax":
@@ -187,25 +195,26 @@ class AsyncReplicaServer:
             from .service import jax_backend
 
             self.verify = jax_backend
+        elif verifier not in ("", "cpu") and (
+            ":" in verifier or verifier.startswith("/")
+        ):
+            # A "host:port" / unix-path spec dials the colocated verify
+            # service (mirror of pbftd's RemoteVerifier): short connect
+            # deadline, readiness handshake, and the PR-2 native pool as
+            # the per-batch fallback whenever the service is warming,
+            # unreachable, or dies mid-stream — consensus never blocks
+            # on a cold accelerator.
+            from .verify_service import ServiceVerifier
+
+            self.service_verifier = ServiceVerifier(verifier)
+            self.verify = self.service_verifier.verify_batch
         else:
-            # Host CPU arm: the native C++ batch verifier when built
-            # (114 us/item), else the pure-Python oracle (~8 ms/item).
-            # Byte-identical accept sets (tests/test_native_crypto.py), so
-            # the choice cannot diverge replicas.
-            self.verify = None
-            try:
-                from .. import native
-
-                if native.available():
-                    self.verify = native.verify_batch
-            except Exception:  # pragma: no cover - unbuilt native core
-                pass
-            if self.verify is None:
-                from ..crypto import ref
-
-                self.verify = lambda items: [
-                    ref.verify(p, m, s) for p, m, s in items
-                ]
+            # Host CPU arm (consensus.replica.host_batch_verify): the
+            # native C++ batch verifier when built (114 us/item), else
+            # the pure-Python oracle (~8 ms/item). Byte-identical accept
+            # sets (tests/test_native_crypto.py), so the choice cannot
+            # diverge replicas.
+            self.verify = host_batch_verify
         self.vc_timeout = vc_timeout
         self.secure = config.secure
         self._seed = seed
@@ -952,6 +961,14 @@ class AsyncReplicaServer:
             "port": self.listen_port,
             "frames_in": self.frames_in,
             "verify_batches": self.batches_run,
+            # Remote-verifier health (service spec only): batches the
+            # local native pool absorbed because the service was warming,
+            # unreachable, or died mid-stream.
+            "verify_service_fallbacks": (
+                self.service_verifier.used_fallback
+                if self.service_verifier is not None
+                else 0
+            ),
             "broadcasts": self.broadcasts,
             "broadcast_encodes": self.broadcast_encodes,
             "codec_binary_frames": self.codec_binary_frames,

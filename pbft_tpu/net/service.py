@@ -22,13 +22,57 @@ queued while the previous launch ran".
 
 from __future__ import annotations
 
+import json
 import socket
 import socketserver
+import struct
 import threading
 import time
 from typing import Callable, List, Optional, Tuple
 
 Item = Tuple[bytes, bytes, bytes]
+
+# -- readiness handshake wire format (ISSUE 7) -------------------------------
+#
+# Request header (u32be item count) values that are NOT batches:
+#   STATUS_PROBE (0)               -> 8-byte binary status reply
+#   STATUS_JSON_PROBE (0xFFFFFFFF) -> u32be length + JSON status reply
+# Real batches are capped far below (MAX_WINDOW / the C++ async write
+# budget), so neither value can collide with traffic; pre-handshake
+# clients never sent count 0 (an empty batch was short-circuited before
+# the socket on both runtimes).
+
+STATUS_PROBE = 0
+STATUS_JSON_PROBE = 0xFFFFFFFF
+STATUS_MAGIC = b"VS"
+STATUS_VERSION = 1
+STATUS_LEN = 8
+
+STATE_WARMING = 0
+STATE_READY = 1
+STATE_CPU_ONLY = 2
+STATE_NAMES = {
+    STATE_WARMING: "warming",
+    STATE_READY: "ready",
+    STATE_CPU_ONLY: "cpu-only",
+}
+
+
+def pack_status(state: int, devices: int, warmed: int) -> bytes:
+    """8 bytes: 'V' 'S' version state u16be devices u16be warmed-shapes."""
+    return STATUS_MAGIC + struct.pack(
+        ">BBHH", STATUS_VERSION, state, min(devices, 0xFFFF), min(warmed, 0xFFFF)
+    )
+
+
+def unpack_status(blob: bytes) -> Optional[Tuple[int, int, int]]:
+    """(state, devices, warmed_shapes), or None if not a status record."""
+    if len(blob) != STATUS_LEN or blob[:2] != STATUS_MAGIC:
+        return None
+    version, state, devices, warmed = struct.unpack(">BBHH", blob[2:])
+    if version != STATUS_VERSION or state not in STATE_NAMES:
+        return None
+    return state, devices, warmed
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -96,7 +140,10 @@ class VerifierService:
         trace_path: Optional[str] = None,
         inflight: int = 1,
         metrics_port: Optional[int] = None,
+        status_provider: Optional[Callable[[], Tuple[int, int, int]]] = None,
+        status_json_provider: Optional[Callable[[], dict]] = None,
     ):
+        backend_name = backend if isinstance(backend, str) else None
         if isinstance(backend, str):
             backend = {
                 "jax": jax_backend,
@@ -104,6 +151,29 @@ class VerifierService:
                 "native": native_backend,
             }[backend]
         self.backend = backend
+        # Readiness handshake (verify_service.py): a bare VerifierService
+        # has no warmup lifecycle, so the default status is settled at
+        # construction — "ready" for the jax backend (it warms lazily on
+        # first traffic, the pre-daemon behavior), "cpu-only" for
+        # everything else (incl. test callables). The daemon overrides
+        # both providers with its live state machine.
+        self._status_provider = status_provider or (
+            lambda: (
+                STATE_READY if backend_name == "jax" else STATE_CPU_ONLY,
+                0,
+                0,
+            )
+        )
+        self._status_json_provider = status_json_provider or (
+            lambda: {
+                "state": STATE_NAMES[self._status_provider()[0]],
+                "devices": self._status_provider()[1],
+                "backend": backend_name or "custom",
+                "requests": self.requests,
+                "launches": self.batches,
+                "items": self.items,
+            }
+        )
         # Bounded accumulation (the service-side analogue of the replicas'
         # verify_flush_us): after the first request queues, the dispatcher
         # waits until flush_items are pending (0 = MAX_WINDOW) or flush_us
@@ -158,6 +228,17 @@ class VerifierService:
                     while True:
                         header = _recv_exact(sock, 4)
                         n = int.from_bytes(header, "big")
+                        if n == STATUS_PROBE:
+                            # Readiness handshake: replicas/bench decide
+                            # whether to route here before shipping work.
+                            sock.sendall(pack_status(*service._status_provider()))
+                            continue
+                        if n == STATUS_JSON_PROBE:
+                            blob = json.dumps(
+                                service._status_json_provider()
+                            ).encode()
+                            sock.sendall(len(blob).to_bytes(4, "big") + blob)
+                            continue
                         blob = _recv_exact(sock, n * 128)
                         items = [
                             (
@@ -222,6 +303,17 @@ class VerifierService:
                 self.metrics_registry.histogram("pbft_verify_seconds").observe(
                     time.monotonic() - t0
                 )
+                # Service-surface mirror (ISSUE 7): uncoalesced, every
+                # request is its own single-client launch window.
+                self.metrics_registry.counter(
+                    "pbft_verify_service_launches_total"
+                ).inc()
+                self.metrics_registry.histogram(
+                    "pbft_verify_service_window_size"
+                ).observe(len(items))
+                self.metrics_registry.histogram(
+                    "pbft_verify_service_coalesced_clients"
+                ).observe(1)
             return verdicts
         p = _Pending(items)
         with self._cond:
@@ -361,6 +453,18 @@ class VerifierService:
                 self.metrics_registry.gauge("pbft_verify_inflight_age_seconds").set(
                     round(secs, 6)
                 )
+                # Service launch surface (ISSUE 7): items per XLA launch
+                # and how many connections each merged window carried —
+                # the coalescing win the launch-cost model prices.
+                self.metrics_registry.counter(
+                    "pbft_verify_service_launches_total"
+                ).inc()
+                self.metrics_registry.histogram(
+                    "pbft_verify_service_window_size"
+                ).observe(len(merged))
+                self.metrics_registry.histogram(
+                    "pbft_verify_service_coalesced_clients"
+                ).observe(len(window))
                 if verdicts is not None:
                     self.metrics_registry.counter("pbft_verify_rejected_total").inc(
                         verdicts.count(False)

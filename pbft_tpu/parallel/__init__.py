@@ -11,6 +11,8 @@ consensus core.
 
 from .verifier import (
     QuorumResult,
+    batch_sharding,
+    compile_sharded,
     make_mesh,
     sharded_verify,
     verify_many_auto,
@@ -27,6 +29,8 @@ from .multihost import (
 
 __all__ = [
     "QuorumResult",
+    "batch_sharding",
+    "compile_sharded",
     "make_mesh",
     "sharded_verify",
     "verify_many_auto",

@@ -58,22 +58,65 @@ def make_mesh(
     return Mesh(np.asarray(devices), (axis,))
 
 
-def sharded_verify(mesh: Mesh, axis: str = "batch"):
+def sharded_verify(
+    mesh: Mesh, axis: str = "batch", donate: bool = False, kernel=None
+):
     """jit'd (B,32),(B,32),(B,64) uint8 -> (B,) bool, batch-sharded.
 
     Shard-local compute only — XLA partitions the vmapped kernel with no
-    collectives. B must be divisible by the mesh size.
+    collectives. B must be divisible by the mesh size. ``donate=True``
+    marks the three input buffers donated so XLA reuses their device
+    memory across launches (the verify service re-stages every window, so
+    its inputs are dead the moment the launch reads them). ``kernel``
+    overrides the Ed25519 kernel (tests substitute a cheap stand-in to
+    exercise the serving plumbing without a minutes-long compile).
     """
     spec = NamedSharding(mesh, P(axis))
+    kern = kernel or verify_kernel
 
-    @jax.jit
     def fn(pubs, msgs, sigs):
         pubs = jax.lax.with_sharding_constraint(pubs, spec)
         msgs = jax.lax.with_sharding_constraint(msgs, spec)
         sigs = jax.lax.with_sharding_constraint(sigs, spec)
-        return verify_kernel(pubs, msgs, sigs)
+        return kern(pubs, msgs, sigs)
 
-    return fn
+    return jax.jit(fn, donate_argnums=(0, 1, 2) if donate else ())
+
+
+def batch_sharding(mesh: Mesh, axis: str = "batch") -> NamedSharding:
+    """The (B, …) input sharding the verify launches expect — callers
+    ``jax.device_put`` against it to stage a window ahead of the launch."""
+    return NamedSharding(mesh, P(axis))
+
+
+def compile_sharded(
+    mesh: Mesh,
+    size: int,
+    axis: str = "batch",
+    donate: bool = True,
+    kernel=None,
+):
+    """AOT-compile the sharded verifier for one fixed window size.
+
+    ``jax.jit(...).lower(...).compile()`` ahead of first traffic: the
+    persistent verify service warms every `_PAD_LADDER` shape at startup
+    so no request ever pays tracing or compilation (the persistent
+    on-disk cache makes the warm-restart compile cache-hit cheap; the
+    serialized-executable export in net/verify_service.py skips even
+    tracing). Returns a ``jax.stages.Compiled`` expecting inputs placed
+    with :func:`batch_sharding`.
+    """
+    if size % mesh.devices.size:
+        raise ValueError(
+            f"window {size} not divisible by mesh size {mesh.devices.size}"
+        )
+    spec = NamedSharding(mesh, P(axis))
+    fn = sharded_verify(mesh, axis, donate=donate, kernel=kernel)
+    return fn.lower(
+        jax.ShapeDtypeStruct((size, 32), jnp.uint8, sharding=spec),
+        jax.ShapeDtypeStruct((size, 32), jnp.uint8, sharding=spec),
+        jax.ShapeDtypeStruct((size, 64), jnp.uint8, sharding=spec),
+    ).compile()
 
 
 # One compiled sharded verifier per process: the serving path below is

@@ -1,13 +1,24 @@
-"""Host-feature-keyed persistent compile cache location.
+"""Host-keyed persistent compile cache location.
 
 XLA:CPU AOT cache entries embed the compiling machine's CPU features;
 loading an entry compiled on a better-featured host only WARNS at load
 time but can SIGILL at execution time. The multichip dryrun is the one
 gate that must never flake, and its workspace (including `.jax_cache/`)
 can move between hosts — so the cache directory is keyed by the host's
-machine type + CPU feature flags: a foreign cache lands under a
-different key and is simply never read. The cost of a feature mismatch
-is a cold recompile, never a crash.
+identity AND its CPU description: a foreign cache lands under a
+different key and is simply never read. The cost of a key mismatch is a
+cold recompile, never a crash.
+
+Why both components (MULTICHIP_r05 postmortem): keying by the
+`/proc/cpuinfo` feature flags alone was not enough — XLA's *target*
+feature set is derived from the CPU model (e.g. `+prefer-no-gather` on
+some microarchitectures), so two hosts can report byte-identical flag
+lists yet compile incompatible AOT artifacts, and the r05 log duly
+spewed `cpu_aot_loader` feature-mismatch warnings threatening SIGILL.
+The key therefore folds in (a) a stable host id (`/etc/machine-id`,
+falling back to the hostname) and (b) the machine type + CPU model name
++ feature flags. Same host, same kernel → same key → warm cache; any
+move or CPU change → new key → cold but safe.
 
 This module must stay importable without touching jax (bench.py and
 __graft_entry__.py compute the cache path before backend init).
@@ -20,19 +31,46 @@ import os
 import platform
 
 
-def host_cache_key() -> str:
-    """12-hex digest of this host's machine type + CPU feature flags."""
-    flags = ""
+def _cpuinfo_fields(*names: str) -> str:
+    """First occurrence of each named /proc/cpuinfo field, joined."""
+    found = {n: "" for n in names}
     try:
         with open("/proc/cpuinfo") as fh:
             for line in fh:
-                if line.split(":")[0].strip() in ("flags", "Features"):
-                    flags = line.split(":", 1)[1].strip()
+                key = line.split(":")[0].strip()
+                if key in found and not found[key]:
+                    found[key] = line.split(":", 1)[1].strip()
+                if all(found.values()):
                     break
     except OSError:
-        pass  # non-Linux: machine type alone still separates real moves
+        pass  # non-Linux: machine type + host id still separate real moves
+    return "|".join(found[n] for n in names)
+
+
+def _host_id() -> str:
+    """A stable identifier for THIS host (not the workspace)."""
+    for path in ("/etc/machine-id", "/var/lib/dbus/machine-id"):
+        try:
+            with open(path) as fh:
+                hid = fh.read().strip()
+            if hid:
+                return hid
+        except OSError:
+            continue
+    return platform.node()
+
+
+def host_cache_key() -> str:
+    """12-hex digest of host id + machine type + CPU model + features.
+
+    ``PBFT_CACHE_HOST_KEY`` overrides the computed key (tests pin it to
+    exercise warm-restart behavior deterministically)."""
+    override = os.environ.get("PBFT_CACHE_HOST_KEY")
+    if override:
+        return override
+    cpu = _cpuinfo_fields("model name", "flags", "Features")
     return hashlib.blake2b(
-        f"{platform.machine()}|{flags}".encode(), digest_size=6
+        f"{_host_id()}|{platform.machine()}|{cpu}".encode(), digest_size=6
     ).hexdigest()
 
 

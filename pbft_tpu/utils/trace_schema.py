@@ -108,6 +108,35 @@ METRIC_SCHEMAS = {
     # proof the injection ran.
     "pbft_faults_injected_total": ("counter", {"server.py", "net.cc"}),
     "pbft_chaos_dropped_total": ("counter", {"server.py", "net.cc"}),
+    # Persistent verify-service surface (ISSUE 7): XLA launches the
+    # coalescing dispatcher actually shipped, items per launch window,
+    # and how many client connections each merged window carried. The
+    # warm/cold compile gauges record the once-per-deploy startup cost
+    # (cold = traced+compiled shapes, warm = serialized-executable or
+    # cache reloads) so the bench can report it OUTSIDE the timed
+    # region. Registered in core/metrics.cc too (eager registration:
+    # every runtime exposes the same series set, zero-valued where the
+    # lifecycle can't happen).
+    "pbft_verify_service_launches_total": (
+        "counter",
+        {"service.py", "net.cc"},
+    ),
+    "pbft_verify_service_window_size": (
+        "histogram",
+        {"service.py", "net.cc"},
+    ),
+    "pbft_verify_service_coalesced_clients": (
+        "histogram",
+        {"service.py", "net.cc"},
+    ),
+    "pbft_verify_service_cold_compile_seconds": (
+        "gauge",
+        {"verify_service.py", "net.cc"},
+    ),
+    "pbft_verify_service_warm_compile_seconds": (
+        "gauge",
+        {"verify_service.py", "net.cc"},
+    ),
     "pbft_batch_size": ("histogram", {"server.py", "net.cc"}),
     "pbft_verify_batch_size": ("histogram", {"server.py", "service.py", "net.cc"}),
     "pbft_verify_seconds": ("histogram", {"server.py", "service.py", "net.cc"}),
@@ -148,6 +177,8 @@ def histogram_buckets(name: str):
         "pbft_verify_batch_size",
         "pbft_verify_pool_window_size",
         "pbft_batch_size",
+        "pbft_verify_service_window_size",
+        "pbft_verify_service_coalesced_clients",
     ):
         return BATCH_SIZE_BUCKETS
     return LATENCY_BUCKETS_S

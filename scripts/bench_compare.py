@@ -1,0 +1,208 @@
+#!/usr/bin/env python
+"""bench_compare — diff two benchmark runs and gate regressions.
+
+The BENCH trajectory (9.4k -> 8.5k -> 17.9k verifies/sec) is too noisy to
+eyeball (ROADMAP item 4): this tool makes "did this PR slow us down?" a
+CI exit code. It reads two benchmark files — JSONL (one JSON object per
+run, the harness format in benchmarks/*.jsonl) or a single JSON object
+(the bench.py result line) — aggregates each named metric across runs
+(median by default, robust to one noisy run), and exits nonzero when any
+metric regressed by more than ``--max-regress-pct``.
+
+    python scripts/bench_compare.py benchmarks/protocol_r6_pre.jsonl \\
+        benchmarks/protocol_r6_native.jsonl --max-regress-pct 10
+
+Metrics are higher-is-better unless listed in ``--lower-better``.
+Defaults compare every known rate metric present in BOTH files.
+Exit codes: 0 ok, 1 regression, 2 usage/data error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import statistics
+import sys
+from typing import Dict, List
+
+# Rate metrics the harnesses emit today; --metric overrides.
+DEFAULT_METRICS = (
+    "rounds_per_sec",
+    "requests_per_sec",
+    "sig_verifies_per_sec",
+    "value",  # bench.py single-line result (verifies/sec)
+)
+
+
+def load_runs(path: str) -> List[dict]:
+    """A JSONL file of run objects, or a single JSON object/array."""
+    with open(path) as fh:
+        text = fh.read().strip()
+    if not text:
+        raise ValueError(f"{path}: empty benchmark file")
+    try:
+        obj = json.loads(text)
+        if isinstance(obj, dict):
+            return [obj]
+        if isinstance(obj, list):
+            return [r for r in obj if isinstance(r, dict)]
+    except ValueError:
+        pass
+    runs = []
+    for i, line in enumerate(text.splitlines(), 1):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            row = json.loads(line)
+        except ValueError as e:
+            raise ValueError(f"{path}:{i}: not JSON ({e})") from e
+        if isinstance(row, dict):
+            runs.append(row)
+    if not runs:
+        raise ValueError(f"{path}: no run objects found")
+    return runs
+
+
+def collect(runs: List[dict], metric: str) -> List[float]:
+    out = []
+    for row in runs:
+        v = row.get(metric)
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            out.append(float(v))
+    return out
+
+
+AGGREGATES = {
+    "median": statistics.median,
+    "mean": statistics.fmean,
+    "min": min,
+    "max": max,
+}
+
+
+def compare(
+    old_runs: List[dict],
+    new_runs: List[dict],
+    metrics: List[str],
+    max_regress_pct: float,
+    agg: str = "median",
+    lower_better: frozenset = frozenset(),
+) -> Dict[str, dict]:
+    """Per-metric {old, new, delta_pct, regressed}. ``delta_pct`` is
+    signed improvement (positive = better), so the gate is uniform:
+    ``regressed = delta_pct < -max_regress_pct``."""
+    fn = AGGREGATES[agg]
+    report = {}
+    for metric in metrics:
+        old_vals = collect(old_runs, metric)
+        new_vals = collect(new_runs, metric)
+        if not old_vals or not new_vals:
+            continue
+        old, new = fn(old_vals), fn(new_vals)
+        if old == 0:
+            delta_pct = 0.0 if new == 0 else float("inf")
+        else:
+            delta_pct = (new - old) / abs(old) * 100.0
+        if metric in lower_better:
+            delta_pct = -delta_pct
+        report[metric] = {
+            "old": round(old, 3),
+            "new": round(new, 3),
+            "runs": (len(old_vals), len(new_vals)),
+            "delta_pct": round(delta_pct, 2),
+            "regressed": delta_pct < -max_regress_pct,
+        }
+    return report
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter
+    )
+    parser.add_argument("old", help="baseline benchmark file (json/jsonl)")
+    parser.add_argument("new", help="candidate benchmark file (json/jsonl)")
+    parser.add_argument(
+        "--metric",
+        action="append",
+        default=None,
+        help="metric field to gate (repeatable; default: every known "
+        "rate metric present in both files)",
+    )
+    parser.add_argument(
+        "--max-regress-pct",
+        type=float,
+        default=10.0,
+        help="fail when a metric drops by more than this percent "
+        "(default 10)",
+    )
+    parser.add_argument(
+        "--agg",
+        choices=sorted(AGGREGATES),
+        default="median",
+        help="aggregate across runs in a file (default median)",
+    )
+    parser.add_argument(
+        "--lower-better",
+        action="append",
+        default=[],
+        help="metrics where smaller is an improvement (e.g. latency)",
+    )
+    parser.add_argument(
+        "--json", action="store_true", help="machine-readable report"
+    )
+    args = parser.parse_args(argv)
+
+    try:
+        old_runs = load_runs(args.old)
+        new_runs = load_runs(args.new)
+    except (OSError, ValueError) as e:
+        print(f"bench_compare: {e}", file=sys.stderr)
+        return 2
+    metrics = args.metric or list(DEFAULT_METRICS)
+    report = compare(
+        old_runs,
+        new_runs,
+        metrics,
+        args.max_regress_pct,
+        agg=args.agg,
+        lower_better=frozenset(args.lower_better),
+    )
+    if not report:
+        print(
+            f"bench_compare: no shared numeric metric among {metrics} "
+            f"in {args.old} vs {args.new}",
+            file=sys.stderr,
+        )
+        return 2
+    regressed = [m for m, r in report.items() if r["regressed"]]
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    "ok": not regressed,
+                    "max_regress_pct": args.max_regress_pct,
+                    "agg": args.agg,
+                    "metrics": report,
+                }
+            )
+        )
+    else:
+        width = max(len(m) for m in report)
+        for m, r in report.items():
+            mark = "REGRESSED" if r["regressed"] else "ok"
+            print(
+                f"{m:<{width}}  {r['old']:>12} -> {r['new']:>12}  "
+                f"({r['delta_pct']:+.2f}%)  {mark}"
+            )
+        if regressed:
+            print(
+                f"bench_compare: {', '.join(regressed)} regressed more "
+                f"than {args.max_regress_pct}% ({args.agg} over runs)",
+                file=sys.stderr,
+            )
+    return 1 if regressed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

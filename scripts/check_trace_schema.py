@@ -43,6 +43,7 @@ from pbft_tpu.utils import trace_schema  # noqa: E402
 PY_EMITTERS = {
     "server.py": REPO / "pbft_tpu" / "net" / "server.py",
     "service.py": REPO / "pbft_tpu" / "net" / "service.py",
+    "verify_service.py": REPO / "pbft_tpu" / "net" / "verify_service.py",
 }
 # utils/metrics.py emits consensus_span on behalf of server.py (the spans
 # object is wired there); lint it under the server.py emitter identity.

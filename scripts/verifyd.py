@@ -1,0 +1,29 @@
+#!/usr/bin/env python
+"""verifyd — the persistent multi-chip verify service daemon.
+
+One per TPU host: owns the accelerator, initializes the JAX backend ONCE,
+AOT-warms the sharded verify kernel for every pad-ladder window shape
+(persistent compile cache + serialized-executable exports, so a redeploy
+is cache-hit cheap and a warm restart skips tracing entirely), then
+serves coalesced signature windows to every colocated replica for its
+whole lifetime. Replicas dial it with a short connect deadline and fall
+back to their native verify pool while it warms — start it before, after,
+or during the cluster; consensus never waits.
+
+    python scripts/verifyd.py --port 7600                  # TPU/JAX, all devices
+    python scripts/verifyd.py --backend native             # CPU control arm
+    python scripts/verifyd.py --unix /tmp/verify.sock --metrics-port 9100
+
+Readiness: probe with an item count of 0 (8-byte binary status) or
+0xFFFFFFFF (JSON status); see pbft_tpu/net/verify_service.py.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from pbft_tpu.net.verify_service import main  # noqa: E402
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,129 @@
+"""scripts/bench_compare.py — the CI regression gate over benchmark
+history (ROADMAP item 4): a synthetic >X% drop must exit nonzero, the
+real checked-in trajectory must pass, and data errors must be loud."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(REPO, "scripts", "bench_compare.py")
+BENCH = os.path.join(REPO, "benchmarks")
+
+sys.path.insert(0, REPO)
+from scripts.bench_compare import compare, load_runs  # noqa: E402
+
+
+def run_cli(*args):
+    return subprocess.run(
+        [sys.executable, SCRIPT, *args], capture_output=True, text=True
+    )
+
+
+def test_real_history_improvement_passes():
+    """PR 3's serialize-once win: r6_pre -> r6_native improved, so the
+    gate must pass over the real checked-in benchmark history."""
+    res = run_cli(
+        os.path.join(BENCH, "protocol_r6_pre.jsonl"),
+        os.path.join(BENCH, "protocol_r6_native.jsonl"),
+        "--max-regress-pct",
+        "10",
+    )
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "rounds_per_sec" in res.stdout
+
+
+def test_real_history_batching_win_passes():
+    res = run_cli(
+        os.path.join(BENCH, "batching_r7_pre.jsonl"),
+        os.path.join(BENCH, "batching_r7_batched.jsonl"),
+        "--metric",
+        "requests_per_sec",
+        "--max-regress-pct",
+        "5",
+    )
+    assert res.returncode == 0, res.stdout + res.stderr
+
+
+def test_synthetic_regression_gates(tmp_path):
+    """A 20% drop on a named metric exits 1; inside the threshold it
+    passes — the driver's smoke contract for wiring this into CI."""
+    old = tmp_path / "old.jsonl"
+    new = tmp_path / "new.jsonl"
+    old.write_text(
+        "\n".join(
+            json.dumps({"rounds_per_sec": 100.0 + i}) for i in range(5)
+        )
+    )
+    new.write_text(
+        "\n".join(
+            json.dumps({"rounds_per_sec": 80.0 + i}) for i in range(5)
+        )
+    )
+    res = run_cli(str(old), str(new), "--max-regress-pct", "10", "--json")
+    assert res.returncode == 1
+    report = json.loads(res.stdout)
+    assert report["ok"] is False
+    assert report["metrics"]["rounds_per_sec"]["regressed"] is True
+    # The same delta passes under a looser threshold.
+    res2 = run_cli(str(old), str(new), "--max-regress-pct", "25")
+    assert res2.returncode == 0
+
+
+def test_single_json_result_lines(tmp_path):
+    """bench.py emits ONE JSON object per run — comparing two of those
+    (the 'value' metric) must work for the headline trajectory."""
+    old = tmp_path / "old.json"
+    new = tmp_path / "new.json"
+    old.write_text(json.dumps({"metric": "x", "value": 17934.0}))
+    new.write_text(json.dumps({"metric": "x", "value": 9000.0}))
+    res = run_cli(str(old), str(new), "--metric", "value")
+    assert res.returncode == 1
+    res2 = run_cli(str(new), str(old), "--metric", "value")
+    assert res2.returncode == 0
+
+
+def test_lower_better_inverts_the_gate(tmp_path):
+    old = tmp_path / "old.jsonl"
+    new = tmp_path / "new.jsonl"
+    old.write_text(json.dumps({"p99_ms": 10.0}))
+    new.write_text(json.dumps({"p99_ms": 20.0}))
+    assert run_cli(str(old), str(new), "--metric", "p99_ms").returncode == 0
+    assert (
+        run_cli(
+            str(old), str(new), "--metric", "p99_ms", "--lower-better", "p99_ms"
+        ).returncode
+        == 1
+    )
+
+
+def test_data_errors_are_loud(tmp_path):
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text("")
+    ok = tmp_path / "ok.jsonl"
+    ok.write_text(json.dumps({"rounds_per_sec": 1.0}))
+    assert run_cli(str(empty), str(ok)).returncode == 2
+    assert run_cli(str(ok), str(tmp_path / "missing.jsonl")).returncode == 2
+    # No shared metric -> error, not a silent pass.
+    other = tmp_path / "other.jsonl"
+    other.write_text(json.dumps({"unrelated": 1.0}))
+    assert run_cli(str(ok), str(other)).returncode == 2
+
+
+def test_compare_api_median_is_robust_to_one_outlier():
+    old = [{"v": 100.0}, {"v": 101.0}, {"v": 99.0}]
+    new = [{"v": 100.0}, {"v": 1.0}, {"v": 102.0}]  # one wedged run
+    report = compare(old, new, ["v"], max_regress_pct=10.0)
+    assert report["v"]["regressed"] is False
+
+
+@pytest.mark.parametrize(
+    "name",
+    ["protocol_r6_pre.jsonl", "batching_r7_batched.jsonl"],
+)
+def test_load_runs_on_checked_in_history(name):
+    runs = load_runs(os.path.join(BENCH, name))
+    assert runs and all(isinstance(r, dict) for r in runs)

@@ -151,3 +151,42 @@ def test_sharded_matches_unsharded():
     assert np.asarray(fn(pubs, msgs, sigs)).tolist() == np.asarray(
         verify_batch(pubs, msgs, sigs)
     ).tolist()
+
+
+def test_persistent_engine_matches_oracle_and_native(tmp_path):
+    """ISSUE 7 parity pin: the persistent service's AOT-compiled,
+    donated-buffer engine must produce the SAME accept set as the Python
+    oracle (and the native C++ pool when built) with the REAL Ed25519
+    kernel — invalid items planted at window boundaries and pad slots
+    exercised by an off-ladder batch size."""
+    from pbft_tpu.net import ShardedVerifyEngine
+
+    # 11 items over an (8, 16) ladder: chunk boundary at 8, pad slots
+    # 11..15 in the second window; invalids straddle the boundary.
+    bad = {0, 7, 8, 10}
+    items = _signed_items(11, bad=bad)
+    want = [i not in bad for i in range(11)]
+
+    eng = ShardedVerifyEngine(shapes=(8, 16), export_dir=str(tmp_path))
+    stats = eng.warm()
+    assert stats["shapes"] == [8, 16]
+    got = eng.verify(items)
+    assert got == want  # vs the oracle-signed construction
+
+    from pbft_tpu.crypto import ref
+
+    assert [ref.verify(p, m, s) for p, m, s in items] == want
+    try:
+        from pbft_tpu import native
+
+        native_ok = native.available()
+    except Exception:
+        native_ok = False
+    if native_ok:
+        assert native.verify_batch(items) == want
+
+    # Warm restart over the serialized export: zero compiles, same bits.
+    eng2 = ShardedVerifyEngine(shapes=(8, 16), export_dir=str(tmp_path))
+    s2 = eng2.warm()
+    assert s2["compiled"] == 0 and s2["aot_loaded"] == 2, s2
+    assert eng2.verify(items) == want
