@@ -702,17 +702,43 @@ void test_remote_verifier_readiness() {
     ::close(sv[1]);
   }
   {
-    // Legacy service: no status reply -> assumed ready after the (short)
-    // probe deadline; garbage -> probe fails.
+    // Legacy service: no status reply -> the target is remembered as
+    // pre-handshake (state reads ready) but the probe call must return
+    // FALSE — the timed-out probe is still outstanding on this stream,
+    // and a slow-but-modern service answering it late would mis-pair 8
+    // status bytes with the next batch's verdict bytes (the sanitizer
+    // matrix's race_stress drove this: 'V','S',... surfacing as
+    // signature verdicts). ensure_connected re-dials legacy targets on
+    // a clean stream instead. Garbage status -> probe fails outright.
     int sv[2];
     CHECK(::socketpair(AF_UNIX, SOCK_STREAM, 0, sv) == 0);
     pbft::RemoteVerifier rv("/unused");
     rv.adopt_fd_for_test(sv[0]);
-    CHECK(rv.probe_status_for_test(/*allow_legacy=*/true));
+    CHECK(!rv.probe_status_for_test(/*allow_legacy=*/true));
     CHECK(rv.service_state() == pbft::RemoteVerifier::ServiceState::kReady);
     uint8_t garbage[8] = {'X', 'X', 9, 9, 0, 0, 0, 0};
     CHECK(write(sv[1], garbage, 8) == 8);
     CHECK(!rv.probe_status_for_test());
+    ::close(sv[1]);
+  }
+  {
+    // Regression (ISSUE 8, found by race_stress under TSan timing): a
+    // status reply that lands AFTER the probe deadline must never be
+    // read as verdict bytes. The timed-out stream above was the only
+    // path that could reuse a probe-dirty connection; pin that the
+    // stream is not trusted (probe returns false) even when the late
+    // reply is already sitting in the socket buffer by the next read.
+    int sv[2];
+    CHECK(::socketpair(AF_UNIX, SOCK_STREAM, 0, sv) == 0);
+    pbft::RemoteVerifier rv("/unused");
+    rv.adopt_fd_for_test(sv[0]);
+    CHECK(!rv.probe_status_for_test(/*allow_legacy=*/true));  // times out
+    auto late = pack(1, 1, 5);  // the slow service finally answers
+    CHECK(write(sv[1], late.data(), late.size()) == 8);
+    // The caller's contract after a false probe is drop + re-dial; a
+    // batch must NOT be shipped on this stream. (Before the fix the
+    // probe returned true here and the 8 late bytes became the first 8
+    // "verdicts" of the next batch.)
     ::close(sv[1]);
   }
   ::unsetenv("PBFT_VERIFY_PROBE_MS");

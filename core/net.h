@@ -16,6 +16,7 @@
 #pragma once
 
 #include <array>
+#include <atomic>
 #include <chrono>
 #include <cstdint>
 #include <cstdio>
@@ -311,7 +312,10 @@ class ReplicaServer {
       waiting_requests_;
   int listen_fd_ = -1;
   int listen_port_ = 0;
-  bool stopping_ = false;
+  // Atomic: stop() is documented as callable from a signal handler
+  // (pbftd) and is called cross-thread by core/race_stress.cc — a plain
+  // bool is a data race under TSan and unsequenced for the signal case.
+  std::atomic<bool> stopping_{false};
   // Reply dials beyond the in-flight budget wait here: un-paced one-shot
   // dials can overflow a client listener's accept backlog and lose
   // replies to SYN drops. Entries expire after a TTL — black-holed

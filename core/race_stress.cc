@@ -1,0 +1,489 @@
+// Dedicated race-stress driver for the sanitizer build matrix (ISSUE 8).
+//
+// core_test.cc covers functional behavior; this binary exists to give TSan
+// (and ASan/UBSan) real cross-thread traffic on every surface of the core
+// that is genuinely concurrent:
+//
+//   1. the verify pool (core/verify_pool.cc) across widths, with
+//      concurrent callers and stats readers;
+//   2. the process-wide pool behind CpuVerifier (the Python binding's
+//      concurrency surface);
+//   3. the shared-mutex decompressed-point cache in core/ed25519.cc under
+//      concurrent warm/cold/clear/disable churn;
+//   4. RemoteVerifier dial/reprobe/cancel against a deliberately chaotic
+//      stub service (immediate close, warming, ready, stall), one verifier
+//      per thread with the shared CPU fallback underneath;
+//   5. a 4-replica in-process cluster over real sockets with seeded
+//      link chaos (drop + delay) pumping the per-dest delay queues, each
+//      server's event loop on its own thread, stopped cross-thread.
+//
+// Every phase also asserts functional correctness (verdict parity, reply
+// liveness) so a plain build of this binary doubles as a smoke test.
+// scripts/sanitize.py runs it under every flavor; findings it forced out
+// are pinned by named regression tests (see CHANGES.md PR 8).
+//
+// Usage: race_stress [scale]   (scale >= 1 multiplies iteration counts)
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "ed25519.h"
+#include "messages.h"
+#include "net.h"
+#include "replica.h"
+#include "verifier.h"
+#include "verify_pool.h"
+
+namespace {
+
+int g_failures = 0;
+
+#define CHECK(cond)                                                        \
+  do {                                                                     \
+    if (!(cond)) {                                                         \
+      std::fprintf(stderr, "FAIL %s:%d: %s\n", __FILE__, __LINE__, #cond); \
+      ++g_failures;                                                        \
+    }                                                                      \
+  } while (0)
+
+// Packed (pubs, msgs, sigs, expected) arrays reused read-only by every
+// thread: shared immutable input is exactly what the pool contract allows.
+struct ItemSet {
+  std::vector<uint8_t> pubs, msgs, sigs, want;
+  size_t n = 0;
+};
+
+ItemSet make_items(size_t n, unsigned bad_every) {
+  ItemSet s;
+  s.n = n;
+  s.pubs.resize(32 * n);
+  s.msgs.resize(32 * n);
+  s.sigs.resize(64 * n);
+  s.want.resize(n, 1);
+  // A handful of signer keys so the point cache sees repeats (warm hits).
+  uint8_t seeds[6][32];
+  uint8_t pubs[6][32];
+  for (int k = 0; k < 6; ++k) {
+    std::memset(seeds[k], k + 11, 32);
+    pbft::ed25519_public_key(pubs[k], seeds[k]);
+  }
+  for (size_t i = 0; i < n; ++i) {
+    const int k = (int)(i % 6);
+    uint8_t msg[32];
+    std::memset(msg, 0, 32);
+    std::memcpy(msg, &i, sizeof(i));
+    msg[31] = (uint8_t)k;
+    uint8_t sig[64];
+    pbft::ed25519_sign(sig, seeds[k], msg, 32);
+    if (bad_every && i % bad_every == bad_every - 1) {
+      sig[3] ^= 0x40;  // corrupt: must be rejected on every path
+      s.want[i] = 0;
+    }
+    std::memcpy(s.pubs.data() + 32 * i, pubs[k], 32);
+    std::memcpy(s.msgs.data() + 32 * i, msg, 32);
+    std::memcpy(s.sigs.data() + 64 * i, sig, 64);
+  }
+  return s;
+}
+
+std::vector<pbft::VerifyItem> as_items(const ItemSet& s) {
+  std::vector<pbft::VerifyItem> v(s.n);
+  for (size_t i = 0; i < s.n; ++i) {
+    std::memcpy(v[i].pub, s.pubs.data() + 32 * i, 32);
+    std::memcpy(v[i].msg, s.msgs.data() + 32 * i, 32);
+    std::memcpy(v[i].sig, s.sigs.data() + 64 * i, 64);
+  }
+  return v;
+}
+
+// --- 1. dedicated pools across widths --------------------------------------
+
+void stress_pool_widths(const ItemSet& items, int scale) {
+  for (int width : {1, 2, 4}) {
+    pbft::VerifyPool pool(width);
+    std::atomic<bool> done{false};
+    // Concurrent stats readers: the documented read-side API.
+    std::thread reader([&] {
+      while (!done.load(std::memory_order_relaxed)) {
+        auto st = pool.stats();
+        CHECK(st.threads == width);
+        std::this_thread::yield();
+      }
+    });
+    std::vector<std::thread> callers;
+    for (int t = 0; t < 3; ++t) {
+      callers.emplace_back([&, t] {
+        std::vector<uint8_t> out(items.n);
+        for (int it = 0; it < 2 * scale; ++it) {
+          // Ragged sizes straddling the RLC window width, offset per
+          // thread so claims interleave differently every run.
+          size_t n = items.n - (size_t)((t * 7 + it) % 13);
+          pool.verify(items.pubs.data(), items.msgs.data(), items.sigs.data(),
+                      n, out.data());
+          for (size_t i = 0; i < n; ++i) CHECK(out[i] == items.want[i]);
+        }
+      });
+    }
+    for (auto& th : callers) th.join();
+    done.store(true, std::memory_order_relaxed);
+    reader.join();
+    auto st = pool.stats();
+    CHECK(st.batches == 3 * 2 * scale);
+  }
+}
+
+// --- 2. the process-wide pool via CpuVerifier -------------------------------
+
+void stress_global_pool(const ItemSet& items, int scale) {
+  pbft::set_global_verify_threads(2);
+  std::vector<std::thread> threads;
+  for (int t = 0; t < 3; ++t) {
+    threads.emplace_back([&] {
+      pbft::CpuVerifier v;
+      auto batch = as_items(items);
+      for (int it = 0; it < 2 * scale; ++it) {
+        auto got = v.verify_batch(batch);
+        CHECK(got.size() == items.n);
+        for (size_t i = 0; i < items.n; ++i) CHECK(got[i] == items.want[i]);
+      }
+    });
+  }
+  std::thread reader([&] {
+    for (int i = 0; i < 200 * scale; ++i) {
+      if (pbft::global_verify_pool_created()) {
+        (void)pbft::global_verify_pool().stats();
+      }
+      std::this_thread::yield();
+    }
+  });
+  for (auto& th : threads) th.join();
+  reader.join();
+  pbft::set_global_verify_threads(0);  // restore default width
+}
+
+// --- 3. point cache warm/cold/clear churn -----------------------------------
+
+void stress_point_cache(const ItemSet& items, int scale) {
+  pbft::ed25519_pubkey_cache_clear();
+  std::atomic<bool> done{false};
+  // The churn thread races clear/disable/enable against live verifies:
+  // verdicts must be identical warm, cold, and mid-transition.
+  std::thread churn([&] {
+    while (!done.load(std::memory_order_relaxed)) {
+      pbft::ed25519_pubkey_cache_clear();
+      pbft::ed25519_test_pubkey_cache_disable(true);
+      pbft::ed25519_test_pubkey_cache_disable(false);
+      std::this_thread::yield();
+    }
+  });
+  std::vector<std::thread> verifiers;
+  for (int t = 0; t < 3; ++t) {
+    verifiers.emplace_back([&] {
+      std::vector<uint8_t> out(items.n);
+      for (int it = 0; it < 2 * scale; ++it) {
+        pbft::ed25519_verify_batch(items.pubs.data(), items.msgs.data(),
+                                   items.sigs.data(), items.n, out.data());
+        for (size_t i = 0; i < items.n; ++i) CHECK(out[i] == items.want[i]);
+      }
+    });
+  }
+  for (auto& th : verifiers) th.join();
+  done.store(true, std::memory_order_relaxed);
+  churn.join();
+  pbft::ed25519_test_pubkey_cache_disable(false);
+}
+
+// --- 4. RemoteVerifier vs a chaotic stub service -----------------------------
+
+// Stub behaviors cycled per accepted connection: slam the door, report
+// warming (forces the reprobe state machine), behave (ready + correct
+// verdicts), stall past the probe deadline (forces legacy/drop paths),
+// or answer the probe LATE — after the deadline — which is the exact
+// slow-but-modern shape whose status bytes mis-paired with verdict bytes
+// before the probe_status fix (verifier.cc, pinned in core_test too).
+void chaotic_service(int listen_fd, std::atomic<bool>* stop,
+                     std::atomic<int>* conn_count) {
+  while (!stop->load(std::memory_order_relaxed)) {
+    pollfd pfd{listen_fd, POLLIN, 0};
+    if (::poll(&pfd, 1, 20) <= 0) continue;
+    int fd = ::accept(listen_fd, nullptr, nullptr);
+    if (fd < 0) continue;
+    const int mode = conn_count->fetch_add(1, std::memory_order_relaxed) % 5;
+    if (mode == 0) {  // immediate close
+      ::close(fd);
+      continue;
+    }
+    if (mode == 3) {  // stall: answer nothing until the client gives up
+      std::this_thread::sleep_for(std::chrono::milliseconds(120));
+      ::close(fd);
+      continue;
+    }
+    if (mode == 4) {
+      // Late probe answer: sleep past PBFT_VERIFY_PROBE_MS, then serve
+      // normally (status first). The verifier must have abandoned this
+      // stream — if it didn't, these status bytes become "verdicts".
+      std::this_thread::sleep_for(std::chrono::milliseconds(90));
+    }
+    // Serve the 128-byte-triple protocol: probe (count 0) -> status,
+    // real batches -> all-valid verdicts. Warming mode answers the
+    // status then keeps answering warming on reprobes.
+    const uint8_t state = mode == 1 ? 0 : 1;  // 0 warming, 1 ready
+    for (;;) {
+      uint8_t hdr[4];
+      size_t got = 0;
+      bool dead = false;
+      while (got < 4) {
+        pollfd p{fd, POLLIN, 0};
+        if (::poll(&p, 1, 200) <= 0 || stop->load(std::memory_order_relaxed)) {
+          dead = true;
+          break;
+        }
+        ssize_t r = ::recv(fd, hdr + got, 4 - got, 0);
+        if (r <= 0) {
+          dead = true;
+          break;
+        }
+        got += (size_t)r;
+      }
+      if (dead) break;
+      uint32_t count = ((uint32_t)hdr[0] << 24) | ((uint32_t)hdr[1] << 16) |
+                       ((uint32_t)hdr[2] << 8) | hdr[3];
+      if (count == 0) {
+        uint8_t status[8] = {'V', 'S', 1, state, 0, 1, 0, 5};
+        if (::send(fd, status, 8, MSG_NOSIGNAL) != 8) break;
+        continue;
+      }
+      if (count > 4096) break;
+      std::vector<uint8_t> body(128 * (size_t)count);
+      size_t off = 0;
+      while (off < body.size()) {
+        ssize_t r = ::recv(fd, body.data() + off, body.size() - off, 0);
+        if (r <= 0) {
+          dead = true;
+          break;
+        }
+        off += (size_t)r;
+      }
+      if (dead) break;
+      std::vector<uint8_t> verdicts(count, 1);
+      if (::send(fd, verdicts.data(), verdicts.size(), MSG_NOSIGNAL) !=
+          (ssize_t)verdicts.size())
+        break;
+    }
+    ::close(fd);
+  }
+}
+
+int listen_on_ephemeral(int* port_out) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  addr.sin_port = 0;
+  if (::bind(fd, (sockaddr*)&addr, sizeof(addr)) != 0 ||
+      ::listen(fd, 64) != 0) {
+    ::close(fd);
+    return -1;
+  }
+  socklen_t len = sizeof(addr);
+  ::getsockname(fd, (sockaddr*)&addr, &len);
+  *port_out = ntohs(addr.sin_port);
+  return fd;
+}
+
+void stress_remote_verifier(const ItemSet& small, int scale) {
+  int port = 0;
+  int listen_fd = listen_on_ephemeral(&port);
+  CHECK(listen_fd >= 0);
+  std::atomic<bool> stop{false};
+  std::atomic<int> conns{0};
+  std::thread service(chaotic_service, listen_fd, &stop, &conns);
+  const std::string target = "127.0.0.1:" + std::to_string(port);
+  auto batch = as_items(small);
+  std::vector<std::thread> verifiers;
+  for (int t = 0; t < 3; ++t) {
+    verifiers.emplace_back([&, t] {
+      pbft::RemoteVerifier rv(target);
+      for (int it = 0; it < 6 * scale; ++it) {
+        if ((it + t) % 3 == 0) {
+          // Async launch: ship, drain with a bounded poll loop, cancel
+          // whatever is left in flight (the wedge-deadline path).
+          if (rv.begin_batch(batch)) {
+            std::vector<uint8_t> out;
+            bool failed = false;
+            bool got = false;
+            for (int spin = 0; spin < 50; ++spin) {
+              if (rv.poll_result(&out, &failed)) {
+                got = true;
+                break;
+              }
+              std::this_thread::sleep_for(std::chrono::milliseconds(2));
+            }
+            if (got && !failed) {
+              CHECK(out.size() == batch.size());
+            } else if (!got) {
+              rv.cancel_inflight();
+            }
+          }
+        } else {
+          // Sync path: chaotic transport means verdicts come from either
+          // the service (all 1 here) or the CPU fallback (ground truth);
+          // with an all-valid batch both agree — that IS the contract.
+          auto out = rv.verify_batch(batch);
+          CHECK(out.size() == batch.size());
+          for (auto v : out) CHECK(v == 1);
+        }
+      }
+    });
+  }
+  for (auto& th : verifiers) th.join();
+  stop.store(true, std::memory_order_relaxed);
+  service.join();
+  ::close(listen_fd);
+}
+
+// --- 5. chaos cluster: per-dest delay queues under concurrent event loops ---
+
+void stress_chaos_cluster(int scale) {
+  // Reserve four listener ports by binding ephemerals, then hand them to
+  // the cluster config (closed just before ReplicaServer::start rebinds).
+  int ports[4];
+  int hold[4];
+  for (int i = 0; i < 4; ++i) {
+    hold[i] = listen_on_ephemeral(&ports[i]);
+    CHECK(hold[i] >= 0);
+  }
+  pbft::ClusterConfig cfg;
+  std::vector<std::vector<uint8_t>> seeds;
+  for (int i = 0; i < 4; ++i) {
+    std::vector<uint8_t> seed(32, (uint8_t)(i + 1));
+    pbft::ReplicaIdentity ident;
+    ident.replica_id = i;
+    ident.host = "127.0.0.1";
+    ident.port = ports[i];
+    pbft::ed25519_public_key(ident.pubkey, seed.data());
+    cfg.replicas.push_back(ident);
+    seeds.push_back(seed);
+  }
+  for (int i = 0; i < 4; ++i) ::close(hold[i]);
+  std::vector<std::unique_ptr<pbft::ReplicaServer>> servers;
+  for (int i = 0; i < 4; ++i) {
+    servers.push_back(std::make_unique<pbft::ReplicaServer>(
+        cfg, i, seeds[i].data(), std::make_unique<pbft::CpuVerifier>()));
+    // Drop + delay (drop_pct is a FRACTION, matching server.py and the
+    // chaos_soak callers): 2% of outbound peer frames vanish and the
+    // rest queue in the per-dest FIFO for up to 6ms — poll_once pumps
+    // the queue on every pass, which is the surface under test.
+    servers[i]->set_chaos(/*drop_pct=*/0.02, /*delay_ms=*/6,
+                          /*seed=*/0xBEEF + (uint64_t)i);
+    servers[i]->set_view_change_timeout(400);
+    CHECK(servers[i]->start());
+  }
+  std::vector<std::thread> loops;
+  for (int i = 0; i < 4; ++i) {
+    // run() spins poll_once until the cross-thread stop() below — the
+    // atomic stopping_ flag is itself one of this binary's subjects.
+    loops.emplace_back([srv = servers[i].get()] { srv->run(); });
+  }
+
+  // Client: reply listener + retransmitting sender (PBFT §4.1 contract:
+  // retransmission re-fetches cached replies, so resends are safe).
+  int reply_port = 0;
+  int reply_fd = listen_on_ephemeral(&reply_port);
+  CHECK(reply_fd >= 0);
+  const std::string reply_addr = "127.0.0.1:" + std::to_string(reply_port);
+  const int requests = 3 * scale;
+  int replies_seen = 0;
+  for (int r = 0; r < requests; ++r) {
+    const std::string req =
+        "{\"type\":\"client-request\",\"operation\":\"race-" +
+        std::to_string(r) + "\",\"timestamp\":" + std::to_string(r + 1) +
+        ",\"client\":\"" + reply_addr + "\"}\n";
+    bool replied = false;
+    auto deadline =
+        std::chrono::steady_clock::now() + std::chrono::seconds(20);
+    int attempt = 0;
+    while (!replied && std::chrono::steady_clock::now() < deadline) {
+      // Rotate the entry replica per attempt (forwarding + chaos drops
+      // mean any single path can black-hole).
+      int fd = pbft::dial_tcp("127.0.0.1:" +
+                              std::to_string(ports[attempt++ % 4]));
+      if (fd >= 0) {
+        (void)!::send(fd, req.data(), req.size(), MSG_NOSIGNAL);
+        ::close(fd);
+      }
+      // Collect dialed-back replies for up to 400ms before retransmitting.
+      auto retry_at = std::chrono::steady_clock::now() +
+                      std::chrono::milliseconds(400);
+      while (std::chrono::steady_clock::now() < retry_at) {
+        pollfd pfd{reply_fd, POLLIN, 0};
+        if (::poll(&pfd, 1, 50) <= 0) continue;
+        int cfd = ::accept(reply_fd, nullptr, nullptr);
+        if (cfd < 0) continue;
+        char buf[512];
+        ssize_t n = ::recv(cfd, buf, sizeof(buf) - 1, 0);
+        ::close(cfd);
+        if (n > 0) {
+          replied = true;
+          ++replies_seen;
+          break;
+        }
+      }
+    }
+  }
+  // Liveness through chaos: every request must eventually be answered
+  // (drop is 2% with retransmission; a miss here is a real bug, not bad
+  // luck — 20s of retries versus millisecond rounds).
+  CHECK(replies_seen == requests);
+  for (auto& s : servers) s->stop();  // cross-thread: atomic stopping_
+  for (auto& t : loops) t.join();
+  bool progressed = false;
+  for (auto& s : servers) {
+    if (s->replica().executed_upto() > 0) progressed = true;
+  }
+  CHECK(progressed);
+  ::close(reply_fd);
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  const int scale = argc > 1 ? std::max(1, std::atoi(argv[1])) : 1;
+  // Short dial/probe deadlines keep the chaotic-service phase fast; set
+  // before any thread exists (setenv is not thread-safe against getenv).
+  ::setenv("PBFT_VERIFY_CONNECT_MS", "100", 1);
+  ::setenv("PBFT_VERIFY_PROBE_MS", "60", 1);
+
+  const ItemSet big = make_items(300, 7);   // > one RLC window, some invalid
+  const ItemSet small = make_items(24, 0);  // all valid (service parity)
+
+  std::printf("[race_stress] pool widths...\n");
+  stress_pool_widths(big, scale);
+  std::printf("[race_stress] global pool / CpuVerifier...\n");
+  stress_global_pool(big, scale);
+  std::printf("[race_stress] point cache churn...\n");
+  stress_point_cache(big, scale);
+  std::printf("[race_stress] remote verifier vs chaotic service...\n");
+  stress_remote_verifier(small, scale);
+  std::printf("[race_stress] chaos cluster delay-queue pump...\n");
+  stress_chaos_cluster(scale);
+
+  if (g_failures) {
+    std::fprintf(stderr, "%d failure(s)\n", g_failures);
+    return 1;
+  }
+  std::printf("race stress: all phases clean\n");
+  return 0;
+}

@@ -114,14 +114,20 @@ bool RemoteVerifier::probe_status(bool allow_legacy) {
     if (r <= 0) {
       if (got == 0 && allow_legacy) {
         // A pre-handshake service never answers count 0 (it maps to an
-        // empty batch with an empty reply): assume ready, keep the link,
-        // and remember — later re-dials to this target must not stall
-        // the event loop for another probe deadline.
+        // empty batch with an empty reply): remember the target as
+        // legacy so later dials skip the probe deadline entirely. But
+        // do NOT keep this link: the probe is still outstanding on it,
+        // and a service that is merely SLOW (not legacy) would answer
+        // it late — 8 status bytes mis-pairing with the next batch's
+        // verdict stream, turning protocol framing into signature
+        // verdicts (found by core/race_stress.cc under the sanitizer
+        // matrix, ISSUE 8). The caller drops this connection and
+        // re-dials a clean probe-free stream.
         legacy_ = true;
         state_ = ServiceState::kReady;
         devices_ = 0;
         warmed_ = 0;
-        return true;
+        return false;
       }
       return false;  // wedged, or died mid-status
     }
@@ -164,6 +170,39 @@ bool RemoteVerifier::ensure_connected() {
     retry_after_ = now + std::chrono::milliseconds(reprobe_ms_);
     return false;
   }
+  tune_send_budget();
+  if (legacy_) {
+    // Known pre-handshake target: the probe deadline was paid once on
+    // the first dial; treat every reconnect as ready immediately.
+    state_ = ServiceState::kReady;
+    return true;
+  }
+  if (!probe_status(/*allow_legacy=*/true)) {
+    drop_connection();
+    if (legacy_) {
+      // The probe just timed out and marked this target pre-handshake:
+      // the dropped stream had the probe outstanding (a late answer
+      // would mis-pair with verdict bytes), but the target itself is
+      // reachable — re-dial a clean stream NOW and use it probe-free,
+      // so a genuine legacy service still serves the first verify.
+      retry_after_ = {};
+      if (connect_with_deadline()) {
+        tune_send_budget();
+        state_ = ServiceState::kReady;
+        return true;
+      }
+      retry_after_ = now + std::chrono::milliseconds(reprobe_ms_);
+    }
+    return false;
+  }
+  if (state_ == ServiceState::kWarming) {
+    retry_after_ = now + std::chrono::milliseconds(reprobe_ms_);
+    return false;
+  }
+  return true;
+}
+
+void RemoteVerifier::tune_send_budget() {
   // Best-effort: a roomier send buffer widens the async write budget
   // (the kernel clamps to wmem_max without privileges; harmless if so).
   // The async item budget is then DERIVED from what the kernel actually
@@ -181,21 +220,6 @@ bool RemoteVerifier::ensure_connected() {
     async_budget_items_ = payload > 132 ? (payload - 4) / 128 : 1;
     if (async_budget_items_ > 4096) async_budget_items_ = 4096;
   }
-  if (legacy_) {
-    // Known pre-handshake target: the probe deadline was paid once on
-    // the first dial; treat every reconnect as ready immediately.
-    state_ = ServiceState::kReady;
-    return true;
-  }
-  if (!probe_status(/*allow_legacy=*/true)) {
-    drop_connection();
-    return false;
-  }
-  if (state_ == ServiceState::kWarming) {
-    retry_after_ = now + std::chrono::milliseconds(reprobe_ms_);
-    return false;
-  }
-  return true;
 }
 
 static bool write_all(int fd, const uint8_t* data, size_t n) {

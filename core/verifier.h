@@ -25,7 +25,10 @@
 //   cold accelerator can never block consensus. state ready / cpu-only
 //   -> the service is used (a cpu-only service still coalesces windows
 //   across every colocated daemon). A legacy service that never answers
-//   the probe is assumed ready after the probe deadline.
+//   the probe is assumed ready after the probe deadline — on a FRESH
+//   probe-free connection: the timed-out stream is dropped, so a
+//   slow-but-modern service answering the probe late can never mis-pair
+//   its status bytes with a batch's verdict bytes.
 #pragma once
 
 #include <chrono>
@@ -118,9 +121,18 @@ class RemoteVerifier : public Verifier {
   // timeout, on the consensus event loop's verify path).
   bool connect_with_deadline();
   // allow_legacy: a probe timeout right after connect means a
-  // pre-handshake service (assume ready); on a warming reprobe it means
-  // a wedged service (drop and re-dial later).
+  // pre-handshake service — the target is remembered as legacy but the
+  // call still returns false, because the timed-out probe is OUTSTANDING
+  // on the stream: a slow-but-modern service answering late would
+  // mis-pair 8 status bytes with the next batch's verdict bytes
+  // (race_stress.cc's late-probe service mode reproduces this; pinned by
+  // core_test test_remote_verifier_readiness). ensure_connected re-dials
+  // legacy targets on a clean stream and uses them probe-free. On a
+  // warming reprobe a timeout means a wedged service (drop, retry later).
   bool probe_status(bool allow_legacy);
+  // Size async_budget_items_ from the connection's actual SO_SNDBUF
+  // (called after every successful connect, including legacy re-dials).
+  void tune_send_budget();
   void drop_connection();
   std::string target_;
   int fd_ = -1;

@@ -40,7 +40,12 @@ def _build_direct() -> Path:
         raise RuntimeError("no C++ compiler found for the native core")
     _BUILD_DIR.mkdir(exist_ok=True)
     core = _REPO_ROOT / "core"
+    # Strict by default, like the CMake STRICT option: warnings fail the
+    # build. PBFT_CORE_NO_WERROR=1 is the escape hatch for toolchains
+    # whose headers trip -Wextra (mirrors cmake -DSTRICT=OFF).
     common = ["-O2", "-std=c++17", "-Wall", "-Wextra", "-pthread"]
+    if not os.environ.get("PBFT_CORE_NO_WERROR"):
+        common.append("-Werror")
     subprocess.run(
         [cxx, *common, "-fPIC", "-shared", "-o", str(_LIB_PATH)]
         + [str(core / s) for s in _LIB_SOURCES],

@@ -983,8 +983,11 @@ class AsyncReplicaServer:
         }
 
 
-async def _amain(args) -> None:
-    config = ClusterConfig.from_json(open(args.config).read())
+async def _amain(args, config_text: str) -> None:
+    # config_text is read by main() BEFORE the event loop starts: file
+    # I/O inside a coroutine is a blocking call on the loop (flagged by
+    # pbft_tpu/analysis/async_blocking.py, scripts/pbft_lint.py).
+    config = ClusterConfig.from_json(config_text)
     # --batch-* override network.json (ISSUE 4), mirroring pbftd.
     import dataclasses as _dc
 
@@ -1097,7 +1100,9 @@ def main() -> None:
         from ..utils import set_trace_file
 
         set_trace_file(args.trace)
-    asyncio.run(_amain(args))
+    with open(args.config) as fh:
+        config_text = fh.read()
+    asyncio.run(_amain(args, config_text))
 
 
 if __name__ == "__main__":

@@ -1,273 +1,29 @@
 #!/usr/bin/env python
-"""Schema drift lint: cross-check every trace-event and metric emitter
-against the single manifest (pbft_tpu/utils/trace_schema.py).
+"""Schema drift lint — THIN SHIM (ISSUE 8).
 
-What is checked, per emitter:
-
-- Python emitters (net/server.py, net/service.py, utils/metrics.py): every
-  ``tracer.event("name", field=...)`` call is parsed from the AST — the
-  event name must be in the manifest with this file listed as an emitter,
-  its keyword fields must be a subset of required|optional, and every
-  required field must be present. Every ``registry.counter/gauge/
-  histogram("name")`` lookup must name a manifest metric of that type.
-- C++ emitter (core/net.cc): event names are extracted from the
-  ``\\"ev\\":\\"<name>\\"`` tokens in its format strings — exact
-  two-way match against the manifest's net.cc events; every required
-  field of every net.cc event must appear as a ``\\"field\\":`` token in
-  the file, and every such token must belong to some net.cc event
-  (catches renames in either direction, at file granularity because the
-  consensus_span line is assembled incrementally).
-- C++ metric tables (core/metrics.cc): the kCounterNames/kGaugeNames/
-  kHistogramNames arrays must match the manifest's net.cc metric set
-  name-for-name and type-for-type, and the kLatencyBuckets/kSizeBuckets
-  arrays must equal LATENCY_BUCKETS_S/BATCH_SIZE_BUCKETS value-for-value.
-- Phase names passed to phase_hook in consensus/replica.py and
-  core/replica.cc must be exactly the manifest PHASES.
-
-Run directly (exit 1 + report on drift) or via tests/test_trace_schema.py
-(tier-1: the runtimes cannot drift unnoticed).
+The checker moved into the analysis package as
+``pbft_tpu.analysis.metrics_lint`` (generalized: it now also sweeps every
+pbft_tpu module for unregistered ``pbft_*`` metric lookups, not just the
+declared emitter files). This shim keeps the historical entry point and
+its ``check()`` API working for existing wiring
+(tests/test_trace_schema.py, CI scripts); new callers should use
+``scripts/pbft_lint.py``, which runs this pass alongside the
+cross-runtime constant-conformance and async-blocking passes.
 """
 
 from __future__ import annotations
 
-import ast
 import pathlib
-import re
 import sys
 
 REPO = pathlib.Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO))
 
-from pbft_tpu.utils import trace_schema  # noqa: E402
-
-PY_EMITTERS = {
-    "server.py": REPO / "pbft_tpu" / "net" / "server.py",
-    "service.py": REPO / "pbft_tpu" / "net" / "service.py",
-    "verify_service.py": REPO / "pbft_tpu" / "net" / "verify_service.py",
-}
-# utils/metrics.py emits consensus_span on behalf of server.py (the spans
-# object is wired there); lint it under the server.py emitter identity.
-PY_EMITTER_ALIASES = {
-    REPO / "pbft_tpu" / "utils" / "metrics.py": "server.py",
-}
-NET_CC = REPO / "core" / "net.cc"
-METRICS_CC = REPO / "core" / "metrics.cc"
-
-
-def _event_calls(path: pathlib.Path):
-    """(event_name, keyword_field_set) for every .event(...) call; a
-    conditional name (IfExp) yields one entry per branch."""
-    tree = ast.parse(path.read_text())
-    out = []
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        func = node.func
-        if not (isinstance(func, ast.Attribute) and func.attr == "event"):
-            continue
-        if not node.args:
-            continue
-        arg = node.args[0]
-        names = []
-        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
-            names = [arg.value]
-        elif isinstance(arg, ast.IfExp):
-            for side in (arg.body, arg.orelse):
-                if isinstance(side, ast.Constant) and isinstance(side.value, str):
-                    names.append(side.value)
-        if not names:
-            continue
-        fields = set()
-        dynamic = False
-        for kw in node.keywords:
-            if kw.arg is None:
-                dynamic = True  # **fields: contents checked at the call site
-            else:
-                fields.add(kw.arg)
-        for name in names:
-            out.append((name, fields, dynamic, node.lineno))
-    return out
-
-
-def _metric_lookups(path: pathlib.Path):
-    """(kind, name, lineno) for registry.counter/gauge/histogram("...")."""
-    tree = ast.parse(path.read_text())
-    out = []
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        func = node.func
-        if not (
-            isinstance(func, ast.Attribute)
-            and func.attr in ("counter", "gauge", "histogram")
-        ):
-            continue
-        if node.args and isinstance(node.args[0], ast.Constant):
-            val = node.args[0].value
-            if isinstance(val, str):
-                out.append((func.attr, val, node.lineno))
-    return out
+from pbft_tpu.analysis import metrics_lint  # noqa: E402
 
 
 def check() -> list:
-    errors = []
-    schemas = trace_schema.EVENT_SCHEMAS
-    metrics = trace_schema.METRIC_SCHEMAS
-
-    # -- Python trace events -------------------------------------------------
-    py_seen: dict = {}  # emitter -> set of event names
-    files = [(em, p) for em, p in PY_EMITTERS.items()] + [
-        (em, p) for p, em in PY_EMITTER_ALIASES.items()
-    ]
-    for emitter, path in files:
-        for name, fields, dynamic, line in _event_calls(path):
-            loc = f"{path.name}:{line}"
-            schema = schemas.get(name)
-            if schema is None:
-                errors.append(f"{loc}: event {name!r} not in manifest")
-                continue
-            if emitter not in schema["emitters"]:
-                errors.append(
-                    f"{loc}: {emitter} is not a manifest emitter of {name!r}"
-                )
-            allowed = schema["required"] | schema["optional"]
-            # ts/ev are stamped by Tracer.event itself.
-            extra = fields - allowed
-            if extra:
-                errors.append(
-                    f"{loc}: event {name!r} has unknown fields {sorted(extra)}"
-                )
-            if not dynamic:
-                missing = schema["required"] - fields - {"ts", "ev"}
-                if missing:
-                    errors.append(
-                        f"{loc}: event {name!r} missing required fields "
-                        f"{sorted(missing)}"
-                    )
-            py_seen.setdefault(emitter, set()).add(name)
-    for name, schema in schemas.items():
-        for emitter in schema["emitters"] & set(PY_EMITTERS):
-            if name not in py_seen.get(emitter, set()):
-                errors.append(
-                    f"{emitter}: manifest event {name!r} is never emitted"
-                )
-
-    # -- Python metric lookups ----------------------------------------------
-    metric_files = list(PY_EMITTERS.items()) + [
-        (em, p) for p, em in PY_EMITTER_ALIASES.items()
-    ]
-    py_metrics_seen: dict = {}
-    for emitter, path in metric_files:
-        for kind, name, line in _metric_lookups(path):
-            loc = f"{path.name}:{line}"
-            if name not in metrics:
-                errors.append(f"{loc}: metric {name!r} not in manifest")
-                continue
-            want, emitters = metrics[name]
-            if kind != want:
-                errors.append(
-                    f"{loc}: metric {name!r} looked up as {kind}, "
-                    f"manifest says {want}"
-                )
-            if emitter not in emitters:
-                errors.append(
-                    f"{loc}: {emitter} is not a manifest emitter of {name!r}"
-                )
-            py_metrics_seen.setdefault(emitter, set()).add(name)
-    # ConsensusSpans (utils/metrics.py, wired into server.py) records the
-    # phase histograms through the PHASE_HISTOGRAMS table rather than
-    # string literals — credit those to server.py from the manifest table
-    # itself (drift there is drift in the manifest, not the emitter).
-    py_metrics_seen.setdefault("server.py", set()).update(
-        trace_schema.PHASE_HISTOGRAMS.values()
-    )
-    for name, (kind, emitters) in metrics.items():
-        for emitter in emitters & set(PY_EMITTERS):
-            if name not in py_metrics_seen.get(emitter, set()):
-                errors.append(
-                    f"{emitter}: manifest metric {name!r} is never recorded"
-                )
-
-    # -- C++ trace events (net.cc) ------------------------------------------
-    cc = NET_CC.read_text()
-    cc_events = set(re.findall(r'\\"ev\\":\\"(\w+)\\"', cc))
-    want_cc = {n for n, s in schemas.items() if "net.cc" in s["emitters"]}
-    for name in cc_events - want_cc:
-        errors.append(f"net.cc: event {name!r} not a manifest net.cc event")
-    for name in want_cc - cc_events:
-        errors.append(f"net.cc: manifest event {name!r} is never emitted")
-    cc_fields = set(re.findall(r'\\"(\w+)\\":', cc))
-    known_cc_fields = set()
-    for name in want_cc:
-        known_cc_fields |= schemas[name]["required"] | schemas[name]["optional"]
-    for f in cc_fields - known_cc_fields - cc_events:
-        errors.append(f"net.cc: JSON field {f!r} not in any net.cc event schema")
-    for name in want_cc:
-        for f in schemas[name]["required"] - {"ts", "ev"}:
-            # consensus_span assembles its optional-phase fields from a
-            # plain string-literal names array, so accept either the
-            # \"field\": format-string token or a bare "field" literal.
-            if f not in cc_fields and f'"{f}"' not in cc:
-                errors.append(
-                    f"net.cc: required field {f!r} of event {name!r} "
-                    "never appears in a format string"
-                )
-
-    # -- C++ metric name tables + buckets (metrics.cc) -----------------------
-    mc = METRICS_CC.read_text()
-
-    def array_strings(var):
-        m = re.search(re.escape(var) + r"\[\]\s*=\s*\{(.*?)\};", mc, re.S)
-        return re.findall(r'"([^"]+)"', m.group(1)) if m else None
-
-    want_native = {
-        kind: {n for n, (k, em) in metrics.items() if k == kind and "net.cc" in em}
-        for kind in ("counter", "gauge", "histogram")
-    }
-    for var, kind in (
-        ("kCounterNames", "counter"),
-        ("kGaugeNames", "gauge"),
-        ("kHistogramNames", "histogram"),
-    ):
-        got = array_strings(var)
-        if got is None:
-            errors.append(f"metrics.cc: table {var} not found")
-            continue
-        if set(got) != want_native[kind]:
-            errors.append(
-                f"metrics.cc: {var} = {sorted(got)} != manifest {kind}s "
-                f"{sorted(want_native[kind])}"
-            )
-
-    def array_numbers(var):
-        m = re.search(re.escape(var) + r"\s*=\s*\{(.*?)\};", mc, re.S)
-        if not m:
-            return None
-        return [float(x) for x in re.findall(r"[0-9.]+", m.group(1))]
-
-    for var, want in (
-        ("kLatencyBuckets", list(trace_schema.LATENCY_BUCKETS_S)),
-        ("kSizeBuckets", [float(x) for x in trace_schema.BATCH_SIZE_BUCKETS]),
-    ):
-        got = array_numbers(var)
-        if got != want:
-            errors.append(f"metrics.cc: {var} = {got} != manifest {want}")
-
-    # -- phase names in both replicas ----------------------------------------
-    for path, pattern in (
-        (
-            REPO / "pbft_tpu" / "consensus" / "replica.py",
-            r'hook\("(\w+)"',
-        ),
-        (REPO / "core" / "replica.cc", r'phase_hook\("(\w+)"'),
-    ):
-        got = set(re.findall(pattern, path.read_text()))
-        if got != set(trace_schema.PHASES):
-            errors.append(
-                f"{path.name}: phase_hook phases {sorted(got)} != manifest "
-                f"PHASES {sorted(trace_schema.PHASES)}"
-            )
-    return errors
+    return metrics_lint.check()
 
 
 def main() -> int:

@@ -1,0 +1,216 @@
+#!/usr/bin/env python
+"""sanitize — build and run the C++ core's sanitizer matrix (ISSUE 8).
+
+Three build flavors of the core plus its test binaries:
+
+    strict      -O2 with -Wall -Wextra -Werror (the clean-warning baseline;
+                this is also the default for normal build-core artifacts)
+    tsan        -fsanitize=thread
+    asan-ubsan  -fsanitize=address,undefined
+
+Each flavor builds ``core_test`` and the dedicated race-stress driver
+``core/race_stress.cc`` (verify pool across widths, point-cache churn,
+RemoteVerifier vs a chaotic stub service, a 4-replica chaos cluster
+pumping per-dest delay queues), runs both, and counts unsuppressed
+sanitizer findings in their output. The summary is machine-readable JSON
+(``--json``) in the spirit of scripts/bench_compare.py: CI gates on the
+exit code, dashboards on the file.
+
+Builds use cmake+ninja when available (-DSANITIZE=... -DSTRICT=ON) and
+fall back to driving g++ directly (same flags; mirrors
+pbft_tpu/native.py) on stripped containers.
+
+Exit codes: 0 all flavors clean, 1 findings or test failures, 2 usage /
+toolchain error.
+
+    python scripts/sanitize.py                     # full matrix
+    python scripts/sanitize.py --flavors tsan --scale 3
+    python scripts/sanitize.py --json sanitize_summary.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import shutil
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+CORE = REPO / "core"
+BUILD_ROOT = REPO / "build-core-san"
+
+# Library sources (core/CMakeLists.txt order) + the two test binaries.
+LIB_SOURCES = [
+    "blake2b.cc", "sha512.cc", "ed25519.cc", "json.cc", "messages.cc",
+    "metrics.cc", "replica.cc", "verifier.cc", "verify_pool.cc",
+    "secure.cc", "net.cc", "discovery.cc",
+]
+BINARIES = {
+    "core_test": "core_test.cc",
+    "race_stress": "race_stress.cc",
+}
+
+FLAVORS = {
+    # name -> (extra compile/link flags, sanitizer env)
+    "strict": ([], {}),
+    "tsan": (
+        ["-fsanitize=thread", "-fno-omit-frame-pointer", "-g"],
+        {"TSAN_OPTIONS": "halt_on_error=0 second_deadlock_stack=1"},
+    ),
+    "asan-ubsan": (
+        ["-fsanitize=address,undefined", "-fno-omit-frame-pointer", "-g"],
+        {"ASAN_OPTIONS": "detect_leaks=1", "UBSAN_OPTIONS": "print_stacktrace=1"},
+    ),
+}
+
+# Unsuppressed-finding signatures in sanitizer stderr. UBSan prints
+# "runtime error:" per hit without a banner; the others banner each report.
+FINDING_PATTERNS = (
+    re.compile(r"WARNING: ThreadSanitizer"),
+    re.compile(r"ERROR: AddressSanitizer"),
+    re.compile(r"ERROR: LeakSanitizer"),
+    re.compile(r"runtime error:"),
+)
+
+
+def count_findings(output: str) -> int:
+    return sum(len(p.findall(output)) for p in FINDING_PATTERNS)
+
+
+def build_direct(flavor: str, flags, out_dir: Path) -> dict:
+    """g++ fallback build (no cmake/ninja): whole-archive compile of the
+    library sources into each test binary — simplest correct thing, and
+    sanitizer runtimes prefer static linkage anyway."""
+    cxx = shutil.which("g++") or shutil.which("c++") or shutil.which("clang++")
+    if cxx is None:
+        raise RuntimeError("no C++ compiler found")
+    opt = "-O2" if flavor == "strict" else "-O1"
+    common = [opt, "-std=c++17", "-Wall", "-Wextra", "-Werror", "-pthread"]
+    srcs = [str(CORE / s) for s in LIB_SOURCES]
+    log = []
+    for exe, main_src in BINARIES.items():
+        cmd = [cxx, *common, *flags, "-o", str(out_dir / exe),
+               str(CORE / main_src), *srcs]
+        t0 = time.monotonic()
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        log.append({
+            "binary": exe,
+            "seconds": round(time.monotonic() - t0, 1),
+            "ok": proc.returncode == 0,
+            "stderr_tail": proc.stderr[-2000:],
+        })
+        if proc.returncode != 0:
+            return {"ok": False, "tool": "g++", "steps": log}
+    return {"ok": True, "tool": "g++", "steps": log}
+
+
+def build_cmake(flavor: str, out_dir: Path) -> dict:
+    san = {"strict": "", "tsan": "thread", "asan-ubsan": "address,undefined"}
+    args = ["cmake", "-S", str(CORE), "-B", str(out_dir), "-G", "Ninja",
+            "-DSTRICT=ON"]
+    if san[flavor]:
+        args.append(f"-DSANITIZE={san[flavor]}")
+    log = []
+    for cmd in (args, ["cmake", "--build", str(out_dir)]):
+        t0 = time.monotonic()
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        log.append({
+            "cmd": cmd[0:2],
+            "seconds": round(time.monotonic() - t0, 1),
+            "ok": proc.returncode == 0,
+            "stderr_tail": proc.stderr[-2000:],
+        })
+        if proc.returncode != 0:
+            return {"ok": False, "tool": "cmake", "steps": log}
+    return {"ok": True, "tool": "cmake", "steps": log}
+
+
+def run_flavor(flavor: str, scale: int, timeout_s: int) -> dict:
+    flags, env_extra = FLAVORS[flavor]
+    out_dir = BUILD_ROOT / flavor
+    out_dir.mkdir(parents=True, exist_ok=True)
+    if shutil.which("cmake") and shutil.which("ninja"):
+        build = build_cmake(flavor, out_dir)
+    else:
+        build = build_direct(flavor, flags, out_dir)
+    result = {"flavor": flavor, "build": build, "binaries": {},
+              "findings": 0, "ok": build["ok"]}
+    if not build["ok"]:
+        return result
+    env = dict(os.environ, **env_extra)
+    for exe in BINARIES:
+        cmd = [str(out_dir / exe)]
+        if exe == "race_stress" and scale > 1:
+            cmd.append(str(scale))
+        t0 = time.monotonic()
+        try:
+            proc = subprocess.run(cmd, capture_output=True, text=True,
+                                  env=env, timeout=timeout_s)
+            output = proc.stdout + proc.stderr
+            exit_code = proc.returncode
+        except subprocess.TimeoutExpired as exc:
+            output = ((exc.stdout or b"").decode(errors="replace")
+                      + (exc.stderr or b"").decode(errors="replace"))
+            exit_code = -1
+        findings = count_findings(output)
+        result["binaries"][exe] = {
+            "exit": exit_code,
+            "seconds": round(time.monotonic() - t0, 1),
+            "findings": findings,
+            # First finding banner, for a one-glance triage in CI logs.
+            "first_finding": next(
+                (line for line in output.splitlines()
+                 if any(p.search(line) for p in FINDING_PATTERNS)), None),
+        }
+        result["findings"] += findings
+        if exit_code != 0 or findings:
+            result["ok"] = False
+    return result
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--flavors", default="strict,tsan,asan-ubsan",
+                    help="comma-separated subset of strict,tsan,asan-ubsan")
+    ap.add_argument("--scale", type=int, default=1,
+                    help="race_stress iteration multiplier")
+    ap.add_argument("--timeout", type=int, default=600,
+                    help="per-binary run timeout (seconds)")
+    ap.add_argument("--json", metavar="PATH",
+                    help="write the machine-readable summary here too")
+    args = ap.parse_args()
+
+    flavors = [f.strip() for f in args.flavors.split(",") if f.strip()]
+    unknown = [f for f in flavors if f not in FLAVORS]
+    if unknown:
+        print(f"unknown flavors: {unknown} (have {sorted(FLAVORS)})",
+              file=sys.stderr)
+        return 2
+
+    summary = {"flavors": [], "ok": True, "scale": args.scale}
+    for flavor in flavors:
+        print(f"[sanitize] {flavor}: building + running...", flush=True)
+        res = run_flavor(flavor, args.scale, args.timeout)
+        summary["flavors"].append(res)
+        summary["ok"] = summary["ok"] and res["ok"]
+        status = "clean" if res["ok"] else "FINDINGS/FAILURES"
+        bins = ", ".join(
+            f"{name} exit={b['exit']} findings={b['findings']}"
+            for name, b in res["binaries"].items()) or "build failed"
+        print(f"[sanitize] {flavor}: {status} ({bins})", flush=True)
+
+    blob = json.dumps(summary, indent=2)
+    if args.json:
+        Path(args.json).write_text(blob + "\n")
+    else:
+        print(blob)
+    return 0 if summary["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,224 @@
+"""Tests for the static-analysis layer itself (ISSUE 8).
+
+Two halves:
+
+1. The clean tree passes every pass (this is the tier-1 wiring for
+   scripts/pbft_lint.py — runtime drift fails the build here).
+2. Each pass actually TRIPS on its violation class, proven against a
+   shadow tree: a copy of exactly the files the passes scan, with one
+   deliberate violation injected — a divergent cross-runtime constant, a
+   blocking call inside ``async def``, an unregistered metric. The entry
+   point must exit nonzero on each.
+
+Plus the @slow sanitizer-matrix arm: scripts/sanitize.py builds the
+strict/TSan/ASan+UBSan flavors of core_test + core/race_stress.cc and
+must report zero unsuppressed findings.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from pbft_tpu import analysis  # noqa: E402
+from pbft_tpu.analysis import async_blocking, constants, metrics_lint  # noqa: E402
+
+LINT = REPO / "scripts" / "pbft_lint.py"
+
+
+def _shadow_tree(tmp_path: pathlib.Path) -> pathlib.Path:
+    """Copy exactly the files the passes scan into a fresh tree."""
+    root = tmp_path / "tree"
+    for src in analysis.scanned_files(REPO):
+        rel = src.relative_to(REPO)
+        dst = root / rel
+        dst.parent.mkdir(parents=True, exist_ok=True)
+        shutil.copy(src, dst)
+    return root
+
+
+def _run_lint(root: pathlib.Path, passes: str = None):
+    cmd = [sys.executable, str(LINT), "--root", str(root)]
+    if passes:
+        cmd += ["--passes", passes]
+    return subprocess.run(cmd, capture_output=True, text=True)
+
+
+# -- 1. the clean tree -------------------------------------------------------
+
+def test_clean_tree_all_passes():
+    results = analysis.run_all(REPO)
+    flat = [e for errs in results.values() for e in errs]
+    assert flat == [], "\n".join(flat)
+
+
+def test_entry_point_clean_tree_exit_zero():
+    proc = _run_lint(REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "all passes clean" in proc.stdout
+
+
+def test_entry_point_usage():
+    proc = _run_lint(REPO, passes="no-such-pass")
+    assert proc.returncode == 2
+
+
+# -- 2. each violation class trips its pass ----------------------------------
+
+def test_divergent_constant_trips(tmp_path):
+    root = _shadow_tree(tmp_path)
+    msgs = root / "pbft_tpu" / "consensus" / "messages.py"
+    text = msgs.read_text()
+    assert "WIRE_BINARY_MAGIC = 0xB2" in text
+    msgs.write_text(text.replace(
+        "WIRE_BINARY_MAGIC = 0xB2", "WIRE_BINARY_MAGIC = 0xB3"))
+    errors = constants.check(root)
+    assert any("wire binary magic" in e for e in errors), errors
+    proc = _run_lint(root, passes="constants")
+    assert proc.returncode == 1
+    assert "wire binary magic" in proc.stdout
+
+
+def test_divergent_protocol_version_trips(tmp_path):
+    root = _shadow_tree(tmp_path)
+    sec = root / "pbft_tpu" / "net" / "secure.py"
+    sec.write_text(sec.read_text().replace(
+        'PROTOCOL_VERSION = "pbft-tpu/1.2.0"',
+        'PROTOCOL_VERSION = "pbft-tpu/1.3.0"'))
+    errors = constants.check(root)
+    assert any("protocol version (current)" in e for e in errors), errors
+
+
+def test_divergent_config_default_trips(tmp_path):
+    root = _shadow_tree(tmp_path)
+    cfg = root / "pbft_tpu" / "consensus" / "config.py"
+    cfg.write_text(cfg.read_text().replace(
+        "watermark_window: int = 256", "watermark_window: int = 128"))
+    errors = constants.check(root)
+    assert any("watermark_window" in e for e in errors), errors
+
+
+def test_blocking_call_in_async_trips(tmp_path):
+    root = _shadow_tree(tmp_path)
+    fixture = root / "pbft_tpu" / "net" / "fixture_blocking.py"
+    fixture.write_text(
+        "import time\n"
+        "\n"
+        "\n"
+        "async def stall_the_loop():\n"
+        "    time.sleep(1)  # the violation\n"
+    )
+    errors = async_blocking.check(root)
+    assert any("time.sleep" in e and "stall_the_loop" in e for e in errors), (
+        errors)
+    proc = _run_lint(root, passes="async-blocking")
+    assert proc.returncode == 1
+    assert "time.sleep" in proc.stdout
+
+
+def test_blocking_socket_and_subprocess_trip(tmp_path):
+    root = _shadow_tree(tmp_path)
+    fixture = root / "pbft_tpu" / "net" / "fixture_blocking2.py"
+    fixture.write_text(
+        "import subprocess\n"
+        "\n"
+        "\n"
+        "async def bad_subprocess():\n"
+        "    subprocess.run(['true'])\n"
+        "\n"
+        "\n"
+        "async def bad_socket(sock):\n"
+        "    return sock.recv(4096)\n"
+        "\n"
+        "\n"
+        "async def fine(loop, sock):\n"
+        "    # passing the callable (not calling it) is loop-safe\n"
+        "    await loop.run_in_executor(None, sock.close)\n"
+        "\n"
+        "\n"
+        "async def nested_sync_ok():\n"
+        "    def helper():\n"
+        "        import time\n"
+        "        time.sleep(0)  # runs wherever it's called, not the loop\n"
+        "    return helper\n"
+    )
+    errors = async_blocking.check(root)
+    assert any("subprocess.run" in e for e in errors), errors
+    assert any("sock.recv" in e for e in errors), errors
+    assert not any("nested_sync_ok" in e for e in errors), errors
+    assert not any("'fine'" in e for e in errors), errors
+
+
+def test_unregistered_metric_trips(tmp_path):
+    root = _shadow_tree(tmp_path)
+    fixture = root / "pbft_tpu" / "fixture_metrics.py"
+    fixture.write_text(
+        "def emit(registry):\n"
+        "    registry.counter('pbft_totally_unregistered_total').inc()\n"
+    )
+    errors = metrics_lint.check(root)
+    assert any("pbft_totally_unregistered_total" in e for e in errors), errors
+    proc = _run_lint(root, passes="metrics")
+    assert proc.returncode == 1
+    assert "pbft_totally_unregistered_total" in proc.stdout
+
+
+def test_unregistered_metric_in_emitter_trips(tmp_path):
+    root = _shadow_tree(tmp_path)
+    server = root / "pbft_tpu" / "net" / "server.py"
+    text = server.read_text()
+    anchor = '"pbft_frames_in_total"'
+    assert anchor in text
+    server.write_text(text.replace(anchor, '"pbft_frames_in_renamed_total"', 1))
+    errors = metrics_lint.check(root)
+    assert any("pbft_frames_in_renamed_total" in e for e in errors), errors
+
+
+def test_wrong_metric_kind_trips(tmp_path):
+    root = _shadow_tree(tmp_path)
+    fixture = root / "pbft_tpu" / "fixture_kind.py"
+    fixture.write_text(
+        "def emit(registry):\n"
+        "    registry.gauge('pbft_executed_total').set(1)\n"  # it's a counter
+    )
+    errors = metrics_lint.check(root)
+    assert any("pbft_executed_total" in e and "gauge" in e for e in errors), (
+        errors)
+
+
+def test_scanned_files_exist():
+    """The shadow-tree contract: every scanned path exists in the repo
+    (a rename must update the pass specs, not silently skip)."""
+    for path in analysis.scanned_files(REPO):
+        assert path.exists(), f"scanned file missing: {path}"
+
+
+# -- 3. the sanitizer matrix (@slow) ------------------------------------------
+
+@pytest.mark.slow
+def test_sanitizer_matrix_clean(tmp_path):
+    """Build + run the full flavor matrix (strict, TSan, ASan+UBSan) of
+    core_test and core/race_stress.cc: zero unsuppressed findings and
+    zero test failures, with the machine-readable summary intact."""
+    summary_path = tmp_path / "sanitize_summary.json"
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "sanitize.py"),
+         "--json", str(summary_path)],
+        capture_output=True, text=True, timeout=3000)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    summary = json.loads(summary_path.read_text())
+    assert summary["ok"]
+    flavors = {f["flavor"] for f in summary["flavors"]}
+    assert flavors == {"strict", "tsan", "asan-ubsan"}
+    for flavor in summary["flavors"]:
+        assert flavor["findings"] == 0, flavor
+        for name, binary in flavor["binaries"].items():
+            assert binary["exit"] == 0, (flavor["flavor"], name, binary)
