@@ -552,15 +552,25 @@ def main() -> None:
         # rounds/sec plus the measured mean batch occupancy —
         # PBFT_BATCH_MAX_ITEMS / PBFT_BATCH_FLUSH_US select the batching
         # knobs (1/0 = the pre-batching protocol).
+        import tempfile
+
         from pbft_tpu.bench.harness import run_native_config
 
-        res = run_native_config(
-            1,  # firehose f=1
-            requests=int(os.environ.get("PBFT_BENCH_REQUESTS", "960")),
-            pipeline=int(os.environ.get("PBFT_BENCH_PIPELINE", "64")),
-            batch_max_items=int(os.environ.get("PBFT_BATCH_MAX_ITEMS", "1")),
-            batch_flush_us=int(os.environ.get("PBFT_BATCH_FLUSH_US", "0")),
-        )
+        # Per-request latency waterfall (ISSUE 9): the run traces every
+        # replica into a scratch dir and joins the client-side
+        # send/quorum stamps against request_rx/batch_sealed/
+        # consensus_span — requests_per_sec ships WITH its segment
+        # breakdown (client queue, batch wait, prepared, committed,
+        # execute, reply; p50/p95/p99 each).
+        with tempfile.TemporaryDirectory(prefix="pbft-bench-traces-") as td:
+            res = run_native_config(
+                1,  # firehose f=1
+                requests=int(os.environ.get("PBFT_BENCH_REQUESTS", "960")),
+                pipeline=int(os.environ.get("PBFT_BENCH_PIPELINE", "64")),
+                batch_max_items=int(os.environ.get("PBFT_BATCH_MAX_ITEMS", "1")),
+                batch_flush_us=int(os.environ.get("PBFT_BATCH_FLUSH_US", "0")),
+                trace_dir=td,
+            )
         print(
             json.dumps(
                 {
@@ -571,6 +581,10 @@ def main() -> None:
                     "mean_batch": res.mean_batch,
                     "batch_max_items": res.batch_max_items,
                     "batch_flush_us": res.batch_flush_us,
+                    "reply_p50_ms": res.reply_p50_ms,
+                    "reply_p95_ms": res.reply_p95_ms,
+                    "reply_p99_ms": res.reply_p99_ms,
+                    "segments_ms": res.latency_segments_ms,
                     "backend": "consensus-native",
                 }
             )

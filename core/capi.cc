@@ -8,6 +8,7 @@
 
 #include "blake2b.h"
 #include "ed25519.h"
+#include "flight.h"
 #include "messages.h"
 #include "metrics.h"
 #include "secure.h"
@@ -208,6 +209,33 @@ size_t pbft_metrics_render_empty(const char* replica_label, char* out,
   }
   return text.size();
 }
+
+// --- Black-box flight recorder (core/flight.{h,cc}; Python mirror
+// pbft_tpu/utils/flight.py, decoder scripts/flight_dump.py). These
+// exports let the tier-1 overhead-guard test drive the NATIVE ring:
+// disabled record is a no-op, dump/decode round-trips through the shared
+// binary format, and the Python decoder reads C++ dumps byte-for-byte.
+
+// (Re)size + enable the process-wide ring; capacity 0 disables.
+void pbft_flight_configure(size_t capacity) {
+  pbft::global_flight().configure(capacity);
+}
+
+void pbft_flight_record(int ev, long long view, long long seq, int peer) {
+  pbft::global_flight().record((uint16_t)ev, view, seq, peer);
+}
+
+// Total records ever accepted (not clamped to capacity).
+unsigned long long pbft_flight_total(void) {
+  return pbft::global_flight().total_recorded();
+}
+
+// Write the binary dump; returns the record count, -1 on failure.
+long pbft_flight_dump(const char* path) {
+  return pbft::global_flight().dump(path);
+}
+
+void pbft_flight_reset(void) { pbft::global_flight().reset(); }
 
 // --- Secure-link primitives (interop pinning vs pbft_tpu/net/secure.py).
 

@@ -17,6 +17,7 @@
 
 #include "blake2b.h"
 #include "ed25519.h"
+#include "flight.h"
 #include "json.h"
 #include "messages.h"
 #include "metrics.h"
@@ -746,6 +747,48 @@ void test_remote_verifier_readiness() {
 
 }  // namespace
 
+void test_flight_recorder() {
+  pbft::FlightRecorder fl;
+  // Disabled (unconfigured) recorder: record is a no-op, dump refuses.
+  fl.record(pbft::kFlightExecuted, 0, 1, -1);
+  CHECK(fl.total_recorded() == 0);
+  CHECK(fl.dump("/tmp/pbft-core-test-flight.bin") == -1);
+  // Ring semantics: capacity 4, six records -> the oldest two evicted,
+  // snapshot chronological.
+  fl.configure(4);
+  for (int i = 1; i <= 6; ++i) {
+    fl.record(pbft::kFlightExecuted, 0, i, -1);
+  }
+  auto snap = fl.snapshot();
+  CHECK(snap.size() == 4);
+  CHECK(snap.front().seq == 3 && snap.back().seq == 6);
+  for (size_t i = 1; i < snap.size(); ++i) {
+    CHECK(snap[i].t_ns >= snap[i - 1].t_ns);
+    CHECK(snap[i].ev == pbft::kFlightExecuted);
+  }
+  // Dump round-trip: header + 20-byte little-endian records (the format
+  // pbft_tpu/utils/flight.py decodes byte-for-byte; the Python tier-1
+  // test pins the cross-runtime parity through capi).
+  const char* path = "/tmp/pbft-core-test-flight.bin";
+  CHECK(fl.dump(path) == 4);
+  FILE* f = std::fopen(path, "rb");
+  CHECK(f != nullptr);
+  if (f) {
+    uint8_t buf[16 + 4 * 20];
+    CHECK(std::fread(buf, 1, sizeof(buf), f) == sizeof(buf));
+    std::fclose(f);
+    CHECK(std::memcmp(buf, "PBFTBBX1", 8) == 0);
+    CHECK(buf[8] == 1 && buf[12] == 4);  // version=1, count=4 (LE)
+    // First record's seq field (offset 16 in the record) is 3.
+    CHECK(buf[16 + 16] == 3);
+  }
+  std::remove(path);
+  // disable() stops recording without dropping what is already there.
+  fl.disable();
+  fl.record(pbft::kFlightExecuted, 0, 99, -1);
+  CHECK(fl.total_recorded() == 6);
+}
+
 int main() {
   test_sha512_vectors();
   test_blake2b_vector();
@@ -761,6 +804,7 @@ int main() {
   test_verify_pool_native();
   test_remote_verifier_async();
   test_remote_verifier_readiness();
+  test_flight_recorder();
   if (g_failures) {
     std::fprintf(stderr, "%d failure(s)\n", g_failures);
     return 1;

@@ -1,0 +1,154 @@
+#include "flight.h"
+
+#include <fcntl.h>
+#include <time.h>
+#include <unistd.h>
+
+#include <cstring>
+
+namespace pbft {
+
+namespace {
+
+// On-disk layout (pbft_tpu/utils/trace_schema.py):
+//   header  "PBFTBBX1" + u32le version + u32le count
+//   record  u64le t_ns, u16le ev, i16le peer, i32le view, i32le seq
+constexpr char kMagic[8] = {'P', 'B', 'F', 'T', 'B', 'B', 'X', '1'};
+constexpr uint32_t kVersion = 1;
+constexpr size_t kRecordSize = 20;
+
+uint64_t now_ns() {
+  timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return (uint64_t)ts.tv_sec * 1000000000ull + (uint64_t)ts.tv_nsec;
+}
+
+void put_u16le(uint8_t* p, uint16_t v) {
+  p[0] = (uint8_t)v;
+  p[1] = (uint8_t)(v >> 8);
+}
+
+void put_u32le(uint8_t* p, uint32_t v) {
+  put_u16le(p, (uint16_t)v);
+  put_u16le(p + 2, (uint16_t)(v >> 16));
+}
+
+void put_u64le(uint8_t* p, uint64_t v) {
+  put_u32le(p, (uint32_t)v);
+  put_u32le(p + 4, (uint32_t)(v >> 32));
+}
+
+void pack_record(uint8_t out[kRecordSize], const FlightRecord& r) {
+  put_u64le(out, r.t_ns);
+  put_u16le(out + 8, r.ev);
+  put_u16le(out + 10, (uint16_t)r.peer);
+  put_u32le(out + 12, (uint32_t)r.view);
+  put_u32le(out + 16, (uint32_t)r.seq);
+}
+
+FlightRecord unpack_slot(uint64_t t, uint64_t packed, uint64_t seq) {
+  FlightRecord r;
+  r.t_ns = t;
+  r.ev = (uint16_t)(packed & 0xFFFF);
+  r.peer = (int16_t)(uint16_t)((packed >> 16) & 0xFFFF);
+  r.view = (int32_t)(uint32_t)(packed >> 32);
+  r.seq = (int32_t)(uint32_t)(seq & 0xFFFFFFFF);
+  return r;
+}
+
+bool write_all(int fd, const uint8_t* data, size_t n) {
+  while (n > 0) {
+    ssize_t w = ::write(fd, data, n);
+    if (w <= 0) return false;
+    data += (size_t)w;
+    n -= (size_t)w;
+  }
+  return true;
+}
+
+}  // namespace
+
+void FlightRecorder::configure(size_t capacity) {
+  enabled_.store(false, std::memory_order_relaxed);
+  head_.store(0, std::memory_order_release);
+  if (capacity == 0) {
+    slots_.reset();
+    capacity_ = 0;
+    return;
+  }
+  slots_ = std::make_unique<Slot[]>(capacity);
+  capacity_ = capacity;
+  enabled_.store(true, std::memory_order_release);
+}
+
+void FlightRecorder::reset() { head_.store(0, std::memory_order_release); }
+
+void FlightRecorder::record(uint16_t ev, int64_t view, int64_t seq,
+                            int64_t peer) {
+  if (!enabled_.load(std::memory_order_relaxed)) return;  // THE one branch
+  const uint64_t t = now_ns();
+  const uint64_t i =
+      head_.fetch_add(1, std::memory_order_relaxed) % capacity_;
+  Slot& s = slots_[i];
+  s.t.store(t, std::memory_order_relaxed);
+  s.packed.store((uint64_t)ev |
+                     ((uint64_t)(uint16_t)(int16_t)peer << 16) |
+                     ((uint64_t)(uint32_t)(int32_t)view << 32),
+                 std::memory_order_relaxed);
+  s.seq.store((uint64_t)(uint32_t)(int32_t)seq, std::memory_order_relaxed);
+}
+
+std::vector<FlightRecord> FlightRecorder::snapshot() const {
+  std::vector<FlightRecord> out;
+  if (capacity_ == 0) return out;
+  const uint64_t head = head_.load(std::memory_order_acquire);
+  const uint64_t count = head < capacity_ ? head : capacity_;
+  out.reserve((size_t)count);
+  for (uint64_t k = head - count; k < head; ++k) {
+    const Slot& s = slots_[k % capacity_];
+    out.push_back(unpack_slot(s.t.load(std::memory_order_relaxed),
+                              s.packed.load(std::memory_order_relaxed),
+                              s.seq.load(std::memory_order_relaxed)));
+  }
+  return out;
+}
+
+long FlightRecorder::dump(const char* path) const {
+  if (capacity_ == 0) return -1;
+  int fd = ::open(path, O_WRONLY | O_CREAT | O_TRUNC, 0644);
+  if (fd < 0) return -1;
+  const uint64_t head = head_.load(std::memory_order_acquire);
+  const uint64_t count = head < capacity_ ? head : capacity_;
+  uint8_t hdr[16];
+  std::memcpy(hdr, kMagic, 8);
+  put_u32le(hdr + 8, kVersion);
+  put_u32le(hdr + 12, (uint32_t)count);
+  if (!write_all(fd, hdr, sizeof(hdr))) {
+    ::close(fd);
+    return -1;
+  }
+  // Oldest first; one stack buffer per record so the fatal-signal caller
+  // never allocates.
+  for (uint64_t k = head - count; k < head; ++k) {
+    const Slot& s = slots_[k % capacity_];
+    const FlightRecord r =
+        unpack_slot(s.t.load(std::memory_order_relaxed),
+                    s.packed.load(std::memory_order_relaxed),
+                    s.seq.load(std::memory_order_relaxed));
+    uint8_t rec[kRecordSize];
+    pack_record(rec, r);
+    if (!write_all(fd, rec, sizeof(rec))) {
+      ::close(fd);
+      return -1;
+    }
+  }
+  ::close(fd);
+  return (long)count;
+}
+
+FlightRecorder& global_flight() {
+  static FlightRecorder recorder;
+  return recorder;
+}
+
+}  // namespace pbft

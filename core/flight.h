@@ -1,0 +1,87 @@
+// Black-box flight recorder: a lock-free fixed-size ring of the last N
+// protocol events, dumped to a compact binary file on SIGTERM/fatal so a
+// replica killed mid-soak still ships its final moments (the piece JSONL
+// tracing cannot provide — it only helps processes that lived long enough
+// to flush). Python mirror: pbft_tpu/utils/flight.py; shared on-disk
+// format decoded by scripts/flight_dump.py.
+//
+// Concurrency contract: record() may be called from any thread (the poll
+// loop, race_stress writers); dump()/snapshot() may run concurrently with
+// recorders. Every slot field is a relaxed atomic — a dump racing a
+// write may see one torn (mid-update) record at the ring head, never a
+// data race. The disabled record path is ONE relaxed load + branch (the
+// same discipline as the tracer's attribute check).
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <memory>
+#include <vector>
+
+namespace pbft {
+
+// Event ids mirror pbft_tpu/utils/trace_schema.py FLIGHT_EVENTS — the
+// cross-runtime contract (one dump decoder for both runtimes).
+enum FlightEvent : uint16_t {
+  kFlightRequestRx = 1,
+  kFlightBatchSealed = 2,  // the "request" consensus phase (seal)
+  kFlightPrePrepare = 3,
+  kFlightPrepared = 4,
+  kFlightCommitted = 5,
+  kFlightExecuted = 6,
+  kFlightReplyTx = 7,
+  kFlightViewTimerFired = 8,
+  kFlightViewChangeSent = 9,
+  kFlightNewViewInstalled = 10,
+  kFlightVerifyBatch = 11,
+};
+
+struct FlightRecord {
+  uint64_t t_ns;  // CLOCK_MONOTONIC at record time
+  uint16_t ev;    // FlightEvent
+  int16_t peer;   // context-dependent small int (-1 = none)
+  int32_t view;
+  int32_t seq;
+};
+
+class FlightRecorder {
+ public:
+  // (Re)size the ring and enable recording; capacity 0 disables and frees.
+  void configure(size_t capacity);
+  void disable() { enabled_.store(false, std::memory_order_relaxed); }
+  bool enabled() const { return enabled_.load(std::memory_order_relaxed); }
+
+  // The hot-path entry: one relaxed load + branch when disabled.
+  void record(uint16_t ev, int64_t view, int64_t seq, int64_t peer);
+
+  // Records currently in the ring, oldest first (bounded by capacity).
+  std::vector<FlightRecord> snapshot() const;
+
+  // Write the binary dump (header + records) with open/write — no stdio,
+  // no allocation — so the fatal-signal path can call it. Returns the
+  // record count written, or -1 on open failure / disabled recorder.
+  long dump(const char* path) const;
+
+  uint64_t total_recorded() const {
+    return head_.load(std::memory_order_acquire);
+  }
+  void reset();
+
+ private:
+  struct Slot {
+    std::atomic<uint64_t> t{0};
+    std::atomic<uint64_t> packed{0};  // ev | peer<<16 | view<<32
+    std::atomic<uint64_t> seq{0};
+  };
+
+  std::unique_ptr<Slot[]> slots_;
+  size_t capacity_ = 0;
+  std::atomic<uint64_t> head_{0};
+  std::atomic<bool> enabled_{false};
+};
+
+// The process-wide recorder the native runtime records into
+// (net.cc event points; enabled by pbftd --flight-file / capi).
+FlightRecorder& global_flight();
+
+}  // namespace pbft

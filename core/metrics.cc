@@ -65,12 +65,19 @@ const std::pair<const char*, bool> kHistogramNames[] = {
 };
 
 // JSONL trace events net.cc emits (trace_batch, trace_view_change,
-// trace_consensus_span, trace_verify_deadline).
+// trace_consensus_span, trace_verify_deadline, plus the ISSUE 9
+// request-level waterfall and view-change span events).
 const char* kTraceEventNames[] = {
     "verify_batch",
     "view_change_start",
     "consensus_span",
     "verify_deadline_fired",
+    "request_rx",
+    "batch_sealed",
+    "reply_tx",
+    "view_timer_fired",
+    "view_change_sent",
+    "new_view_installed",
 };
 
 // Integer-valued samples print without a decimal point, matching the

@@ -16,6 +16,7 @@
 #include <type_traits>
 
 #include "ed25519.h"
+#include "flight.h"
 #include "verify_pool.h"
 
 namespace pbft {
@@ -101,6 +102,11 @@ ReplicaServer::ReplicaServer(ClusterConfig cfg, int64_t id,
   // Batch occupancy at every pre-prepare accept (ISSUE 4).
   replica_->batch_hook = [this](int64_t n) {
     metrics_.observe("pbft_batch_size", (double)n);
+  };
+  // View-change spans (ISSUE 9): rare events, stamped into trace lines
+  // + the flight recorder by on_view_event.
+  replica_->view_hook = [this](const char* ev, int64_t v) {
+    on_view_event(ev, v);
   };
 }
 
@@ -392,6 +398,9 @@ void ReplicaServer::process_buffer(Conn& c) {
       if (msg) {
         ++frames_in_;
         metrics_.inc("pbft_frames_in_total");
+        if (auto* req = std::get_if<ClientRequest>(&*msg)) {
+          trace_request_rx(*req);
+        }
         emit(replica_->receive(*msg));
       }
       if (c.rbuf.empty()) return;
@@ -532,6 +541,7 @@ bool ReplicaServer::handle_peer_frame(Conn& c, std::string payload) {
     ++frames_in_;
     metrics_.inc("pbft_frames_in_total");
     if (std::holds_alternative<ClientRequest>(*msg)) {
+      trace_request_rx(std::get<ClientRequest>(*msg));
       emit(replica_->receive(*msg));
     } else {
       // Receive-side canonical reuse: derive the signable digest from
@@ -626,12 +636,121 @@ void ReplicaServer::trace_view_change(int backoff) {
   std::fflush(trace_fp_);
 }
 
+namespace {
+// Minimal JSON string escaping for trace fields carrying client input
+// (the dial-back address): quote/backslash escaped, control bytes
+// dropped. The Python tracer json-escapes implicitly; this keeps mixed
+// traces parseable even against a hostile client string.
+std::string json_escape(const std::string& s) {
+  std::string out;
+  out.reserve(s.size());
+  for (char ch : s) {
+    if (ch == '"' || ch == '\\') {
+      out.push_back('\\');
+      out.push_back(ch);
+    } else if ((unsigned char)ch >= 0x20) {
+      out.push_back(ch);
+    }
+  }
+  return out;
+}
+}  // namespace
+
+void ReplicaServer::trace_request_rx(const ClientRequest& req) {
+  FlightRecorder& fl = global_flight();
+  if (fl.enabled()) {
+    fl.record(kFlightRequestRx, replica_->view(), req.timestamp, -1);
+  }
+  if (!trace_fp_) return;
+  std::fprintf(trace_fp_,
+               "{\"ts\":%.6f,\"ev\":\"request_rx\",\"replica\":%lld,"
+               "\"client\":\"%s\",\"req_ts\":%lld}\n",
+               trace_now(), (long long)id_,
+               json_escape(req.client).c_str(), (long long)req.timestamp);
+  std::fflush(trace_fp_);
+}
+
+void ReplicaServer::trace_batch_sealed(const PrePrepare& pp) {
+  // Flight coverage comes from the "request" phase transition (the seal
+  // itself); this emitter only owns the JSONL join record.
+  if (!trace_fp_) return;
+  double wait_s = pending_batch_wait_s_;
+  if (batch_window_open_) {
+    wait_s = std::chrono::duration<double>(std::chrono::steady_clock::now() -
+                                           batch_window_start_)
+                 .count();
+  }
+  pending_batch_wait_s_ = 0.0;
+  std::string reqs;
+  for (const auto& r : pp.requests) {
+    if (!reqs.empty()) reqs += ",";
+    reqs += "[\"" + json_escape(r.client) + "\"," +
+            std::to_string(r.timestamp) + "]";
+  }
+  std::fprintf(trace_fp_,
+               "{\"ts\":%.6f,\"ev\":\"batch_sealed\",\"replica\":%lld,"
+               "\"view\":%lld,\"seq\":%lld,\"batch\":%lld,\"wait_s\":%.6f,"
+               "\"reqs\":[%s]}\n",
+               trace_now(), (long long)id_, (long long)pp.view,
+               (long long)pp.seq, (long long)pp.requests.size(),
+               std::max(0.0, wait_s), reqs.c_str());
+  std::fflush(trace_fp_);
+}
+
+void ReplicaServer::trace_reply_tx(const ClientReply& reply) {
+  FlightRecorder& fl = global_flight();
+  if (fl.enabled()) {
+    fl.record(kFlightReplyTx, reply.view, reply.timestamp, -1);
+  }
+  if (!trace_fp_) return;
+  std::fprintf(trace_fp_,
+               "{\"ts\":%.6f,\"ev\":\"reply_tx\",\"replica\":%lld,"
+               "\"client\":\"%s\",\"req_ts\":%lld,\"view\":%lld}\n",
+               trace_now(), (long long)id_,
+               json_escape(reply.client).c_str(), (long long)reply.timestamp,
+               (long long)reply.view);
+  std::fflush(trace_fp_);
+}
+
+void ReplicaServer::on_view_event(const char* ev, int64_t v) {
+  const bool sent = std::strcmp(ev, "view_change_sent") == 0;
+  FlightRecorder& fl = global_flight();
+  if (fl.enabled()) {
+    fl.record(sent ? kFlightViewChangeSent : kFlightNewViewInstalled, v, 0,
+              -1);
+  }
+  if (!trace_fp_) return;
+  if (sent) {
+    std::fprintf(trace_fp_,
+                 "{\"ts\":%.6f,\"ev\":\"view_change_sent\",\"replica\":%lld,"
+                 "\"pending_view\":%lld}\n",
+                 trace_now(), (long long)id_, (long long)v);
+  } else {
+    std::fprintf(trace_fp_,
+                 "{\"ts\":%.6f,\"ev\":\"new_view_installed\",\"replica\":"
+                 "%lld,\"view\":%lld}\n",
+                 trace_now(), (long long)id_, (long long)v);
+  }
+  std::fflush(trace_fp_);
+}
+
 // Consensus-phase spans (Replica::phase_hook target). Stamp indices:
 // 0=request (primary only), 1=pre_prepare, 2=prepared, 3=committed;
 // "executed" closes the span. Schemas/metric names are the cross-runtime
 // contract (pbft_tpu/utils/trace_schema.py) — the Python runtime's
 // ConsensusSpans must stay field-for-field identical.
 void ReplicaServer::on_phase(const char* phase, int64_t view, int64_t seq) {
+  FlightRecorder& fl = global_flight();
+  if (fl.enabled()) {
+    // The "request" transition is the primary's seal — recorded under the
+    // batch_sealed flight id (trace_schema FLIGHT_EVENTS contract).
+    uint16_t ev = !std::strcmp(phase, "request")       ? kFlightBatchSealed
+                  : !std::strcmp(phase, "pre_prepare") ? kFlightPrePrepare
+                  : !std::strcmp(phase, "prepared")    ? kFlightPrepared
+                  : !std::strcmp(phase, "committed")   ? kFlightCommitted
+                                                       : kFlightExecuted;
+    fl.record(ev, view, seq, -1);
+  }
   if (!metrics_.enabled && !trace_fp_) return;
   static constexpr size_t kMaxOpenSpans = 4096;
   const double now = trace_now();
@@ -777,7 +896,12 @@ void ReplicaServer::check_batch_flush(
     return;  // keep accumulating: more client requests may arrive
   }
   batch_window_open_ = false;
+  // Stash the measured batch wait for trace_batch_sealed (which runs
+  // inside the emit below, after the window was closed here).
+  pending_batch_wait_s_ =
+      std::chrono::duration<double>(now - batch_window_start_).count();
   emit(replica_->flush_open_batch());
+  pending_batch_wait_s_ = 0.0;
   // A seal refused by a closed watermark window leaves the batch open;
   // re-arm so the next tick retries instead of spinning the deadline.
   if (replica_->open_batch_size() > 0) {
@@ -836,6 +960,14 @@ void ReplicaServer::deliver_verified(size_t n_items,
                                      std::chrono::steady_clock::time_point t0,
                                      std::vector<uint8_t> verdicts) {
   ++batches_run_;
+  {
+    FlightRecorder& fl = global_flight();
+    if (fl.enabled()) {
+      int64_t rej = 0;
+      for (uint8_t v : verdicts) rej += v ? 0 : 1;
+      fl.record(kFlightVerifyBatch, replica_->view(), (int64_t)n_items, rej);
+    }
+  }
   if (metrics_.enabled || trace_fp_) {  // batch boundaries only
     int64_t rejected = 0;
     for (uint8_t v : verdicts) rejected += v ? 0 : 1;
@@ -932,6 +1064,14 @@ Message ReplicaServer::equivocate_variant(const PrePrepare& pp) {
 void ReplicaServer::emit(Actions&& actions) {
   const bool mute = fault_mode_ == FaultMode::kMute;
   for (auto& b : actions.broadcasts) {
+    // A broadcast of our OWN pre-prepare is the seal of a request batch
+    // (ISSUE 9 waterfall join record) — observed before the fault modes,
+    // because even a mute/equivocating primary sealed locally.
+    if (trace_fp_) {
+      if (auto* pp = std::get_if<PrePrepare>(&b.msg)) {
+        if (pp->replica == id_) trace_batch_sealed(*pp);
+      }
+    }
     if (mute) {  // receives but never sends (--fault mute)
       count_fault();
       continue;
@@ -1019,6 +1159,7 @@ void ReplicaServer::emit(Actions&& actions) {
       count_fault();
       continue;
     }
+    trace_reply_tx(r.msg);
     dial_reply(r.client, r.msg);
   }
   observe_execution_metrics();
@@ -1093,6 +1234,23 @@ void ReplicaServer::check_progress_timer() {
     // backoff keeps cascading view changes from thrashing (§4.5.2).
     timer_backoff_ = std::min(timer_backoff_ * 2, 64);
     metrics_.inc("pbft_view_changes_total");
+    // The view-change span opens here (ROADMAP item 4): timer fired ->
+    // view_change_sent (Replica::view_hook) -> new_view_installed.
+    {
+      FlightRecorder& fl = global_flight();
+      if (fl.enabled()) {
+        fl.record(kFlightViewTimerFired, replica_->view(), timer_backoff_,
+                  -1);
+      }
+    }
+    if (trace_fp_) {
+      std::fprintf(trace_fp_,
+                   "{\"ts\":%.6f,\"ev\":\"view_timer_fired\",\"replica\":"
+                   "%lld,\"view\":%lld,\"backoff\":%d}\n",
+                   trace_now(), (long long)id_, (long long)replica_->view(),
+                   timer_backoff_);
+      std::fflush(trace_fp_);
+    }
     trace_view_change(timer_backoff_);
     emit(replica_->start_view_change());
   }

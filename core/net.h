@@ -265,6 +265,17 @@ class ReplicaServer {
   std::unique_ptr<Replica> replica_;
   void trace_batch(int64_t size, int64_t rejected, double secs);
   void trace_view_change(int backoff);
+  // Request-level waterfall events (ISSUE 9; schemas in
+  // pbft_tpu/utils/trace_schema.py): request arrival, the primary's batch
+  // seal (with how long the batch waited open and the [client, req_ts]
+  // join keys), and the reply leaving toward the client. Each also feeds
+  // the black-box flight recorder when it is enabled.
+  void trace_request_rx(const ClientRequest& req);
+  void trace_batch_sealed(const PrePrepare& pp);
+  void trace_reply_tx(const ClientReply& reply);
+  // Replica::view_hook target: view_change_sent / new_view_installed
+  // trace events + flight records (ROADMAP item 4 view-change spans).
+  void on_view_event(const char* ev, int64_t v);
   // Consensus-phase spans (Replica::phase_hook target): stamps each
   // transition; at "executed" observes the per-phase latency histograms
   // and emits one consensus_span trace event (utils/trace_schema.py).
@@ -354,6 +365,10 @@ class ReplicaServer {
   // the replica) or at the batch_flush_us deadline (here).
   bool batch_window_open_ = false;
   std::chrono::steady_clock::time_point batch_window_start_{};
+  // Batch wait stashed by check_batch_flush just before it seals (it
+  // closes the window before emit runs, so trace_batch_sealed would
+  // otherwise read an already-reset window).
+  double pending_batch_wait_s_ = 0.0;
   // Last-seen replica counters, for the executed/rounds metric deltas.
   int64_t seen_executed_ = 0;
   int64_t seen_rounds_ = 0;

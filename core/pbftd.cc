@@ -10,6 +10,7 @@
 //         [--batch-max-items N] [--batch-flush-us US] [--metrics-every 5]
 //         [--fault sig-corrupt|mute|stutter|equivocate]
 //         [--chaos-drop-pct P] [--chaos-delay-ms N] [--chaos-seed S]
+//         [--trace FILE] [--flight-file FILE]
 //
 // The replica listens on its configured port for both framed peer traffic
 // and raw-JSON client connections (sniffed), verifies signature batches via
@@ -22,6 +23,7 @@
 #include <memory>
 #include <string>
 
+#include "flight.h"
 #include "net.h"
 #include "replica.h"
 #include "verifier.h"
@@ -32,10 +34,23 @@ pbft::ReplicaServer* g_server = nullptr;
 void on_signal(int) {
   if (g_server) g_server->stop();
 }
+
+// --flight-file: the black-box dump target. SIGTERM/SIGINT drain through
+// the normal stop path (the dump runs after the loop exits, below); a
+// FATAL signal dumps directly from the handler (core/flight.cc dump is
+// open/write-only, no allocation) and then re-raises the default action
+// so the exit status still tells the truth.
+const char* g_flight_path = nullptr;
+void on_fatal(int sig) {
+  if (g_flight_path) pbft::global_flight().dump(g_flight_path);
+  std::signal(sig, SIG_DFL);
+  std::raise(sig);
+}
 }  // namespace
 
 int main(int argc, char** argv) {
   std::string config_path, seed_hex, verifier_override, discovery, trace_path;
+  std::string flight_path;
   int64_t id = -1;
   int metrics_every = 0;
   int metrics_port = -1;
@@ -66,6 +81,7 @@ int main(int argc, char** argv) {
     else if (a == "--batch-flush-us") batch_flush_us = std::atoll(next());
     else if (a == "--discovery") discovery = next();
     else if (a == "--trace") trace_path = next();
+    else if (a == "--flight-file") flight_path = next();
     else if (a == "--byzantine") fault_mode_name = "sig-corrupt";
     else if (a == "--fault") fault_mode_name = next();
     else if (a == "--chaos-drop-pct") chaos_drop_pct = std::atof(next());
@@ -154,6 +170,16 @@ int main(int argc, char** argv) {
   g_server = &server;
   std::signal(SIGINT, on_signal);
   std::signal(SIGTERM, on_signal);
+  if (!flight_path.empty()) {
+    // Black-box flight recorder (ISSUE 9): the last 8192 protocol events
+    // in a lock-free ring, dumped on every exit path — clean stop, the
+    // final metrics line's sibling, or a fatal signal mid-crash.
+    pbft::global_flight().configure(8192);
+    g_flight_path = flight_path.c_str();
+    std::signal(SIGSEGV, on_fatal);
+    std::signal(SIGABRT, on_fatal);
+    std::signal(SIGBUS, on_fatal);
+  }
   std::fprintf(stderr,
                "pbftd replica %lld listening on %d (verifier=%s, "
                "verify-threads=%d)\n",
@@ -177,5 +203,10 @@ int main(int argc, char** argv) {
     }
   }
   std::fprintf(stderr, "%s\n", server.metrics_json().c_str());
+  if (!flight_path.empty()) {
+    long n = pbft::global_flight().dump(flight_path.c_str());
+    std::fprintf(stderr, "pbftd replica %lld flight recorder: %ld records "
+                 "-> %s\n", (long long)id, n, flight_path.c_str());
+  }
   return 0;
 }

@@ -39,6 +39,7 @@
 #include <vector>
 
 #include "ed25519.h"
+#include "flight.h"
 #include "messages.h"
 #include "net.h"
 #include "replica.h"
@@ -457,6 +458,60 @@ void stress_chaos_cluster(int scale) {
   ::close(reply_fd);
 }
 
+// --- 6. flight recorder: concurrent record vs dump/snapshot ---------------
+//
+// The black-box ring (core/flight.cc) is recorded from the poll loop and
+// dumped from signal/teardown paths — under TSan this leg proves the
+// atomic-slot design holds with writers wrapping the ring WHILE a dumper
+// reads it, plus the disabled path staying a pure no-op cross-thread.
+void stress_flight_recorder(int scale) {
+  auto& fl = pbft::global_flight();
+  fl.configure(512);  // small ring: writers wrap it constantly
+  std::atomic<bool> stop{false};
+  const std::string path =
+      "/tmp/pbft-race-stress-flight-" + std::to_string(::getpid()) + ".bin";
+  std::vector<std::thread> writers;
+  for (int w = 0; w < 4; ++w) {
+    writers.emplace_back([&, w] {
+      int64_t seq = 0;
+      while (!stop.load(std::memory_order_relaxed)) {
+        fl.record(pbft::kFlightExecuted, w, ++seq, w);
+        fl.record(pbft::kFlightPrepared, w, seq, -1);
+      }
+    });
+  }
+  std::thread dumper([&] {
+    while (!stop.load(std::memory_order_relaxed)) {
+      (void)fl.dump(path.c_str());
+      auto snap = fl.snapshot();
+      CHECK(snap.size() <= 512);
+    }
+  });
+  std::this_thread::sleep_for(std::chrono::milliseconds(150 * scale));
+  stop.store(true);
+  for (auto& t : writers) t.join();
+  dumper.join();
+  CHECK(fl.total_recorded() > 0);
+  long dumped = fl.dump(path.c_str());
+  CHECK(dumped == 512);  // writers wrapped the ring many times over
+  // Disabled path: records are a cross-thread no-op (the tier-1 Python
+  // guard asserts the same through capi).
+  fl.disable();
+  const uint64_t before = fl.total_recorded();
+  std::vector<std::thread> noop;
+  for (int w = 0; w < 4; ++w) {
+    noop.emplace_back([&] {
+      for (int i = 0; i < 10000; ++i) {
+        fl.record(pbft::kFlightExecuted, 0, i, -1);
+      }
+    });
+  }
+  for (auto& t : noop) t.join();
+  CHECK(fl.total_recorded() == before);
+  fl.configure(0);  // leave the global recorder off for later legs
+  ::unlink(path.c_str());
+}
+
 }  // namespace
 
 int main(int argc, char** argv) {
@@ -477,6 +532,8 @@ int main(int argc, char** argv) {
   stress_point_cache(big, scale);
   std::printf("[race_stress] remote verifier vs chaotic service...\n");
   stress_remote_verifier(small, scale);
+  std::printf("[race_stress] flight recorder record/dump...\n");
+  stress_flight_recorder(scale);
   std::printf("[race_stress] chaos cluster delay-queue pump...\n");
   stress_chaos_cluster(scale);
 

@@ -641,6 +641,7 @@ Actions Replica::start_view_change(int64_t new_view) {
   in_view_change_ = true;
   pending_view_ = v;
   counters["view_changes_started"] += 1;
+  if (view_hook) view_hook("view_change_sent", v);
   ViewChange vc;
   vc.new_view = v;
   vc.last_stable_seq = low_mark_;
@@ -941,6 +942,7 @@ Actions Replica::enter_new_view(int64_t v, int64_t min_s,
   pending_view_ = 0;
   sealed_ts_.clear();  // per-view primary ordering memory
   counters["view_changes_completed"] += 1;
+  if (view_hook) view_hook("new_view_installed", v);
   for (auto it = view_changes_.begin(); it != view_changes_.end();) {
     if (it->first <= v) it = view_changes_.erase(it);
     else ++it;

@@ -146,6 +146,13 @@ class Replica {
   // costs one bool check per accept.
   std::function<void(int64_t)> batch_hook;
 
+  // View-change observer (ISSUE 9, mirrors the Python replica's
+  // view_hook): hook("view_change_sent", pending_view) when this replica
+  // broadcasts VIEW-CHANGE, hook("new_view_installed", view) when it
+  // enters the new view. Rare events; the net layer stamps them into
+  // trace events + the flight recorder. Unset costs one bool check.
+  std::function<void(const char*, int64_t)> view_hook;
+
   // Optional stateful-app hooks (PBFT §5.3 state transfer). Defaults keep
   // the reference's no-op app ("awesome!", reference src/message.rs:70)
   // with an empty snapshot. A stateful app sets all three; its snapshot is

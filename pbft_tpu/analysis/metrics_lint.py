@@ -48,6 +48,10 @@ PY_EMITTERS = {
     "server.py": pathlib.Path("pbft_tpu/net/server.py"),
     "service.py": pathlib.Path("pbft_tpu/net/service.py"),
     "verify_service.py": pathlib.Path("pbft_tpu/net/verify_service.py"),
+    # The client emits its half of the latency waterfall (client_request
+    # send/first-reply/quorum stamps, ISSUE 9) — held to the same
+    # manifest contract as the replica runtimes.
+    "client.py": pathlib.Path("pbft_tpu/net/client.py"),
 }
 # utils/metrics.py emits consensus_span on behalf of server.py (the spans
 # object is wired there); lint it under the server.py emitter identity.

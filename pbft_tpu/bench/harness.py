@@ -46,6 +46,16 @@ class BenchResult:
     mean_batch: float = 1.0
     batch_max_items: int = 1
     batch_flush_us: int = 0
+    # Client-observed reply latency (ISSUE 9): send -> f+1 quorum, ms,
+    # over the timed region's requests. reply_p99_ms is the field
+    # scripts/bench_compare.py gates (lower is better).
+    reply_p50_ms: float = 0.0
+    reply_p95_ms: float = 0.0
+    reply_p99_ms: float = 0.0
+    # Per-request segment breakdown (utils/waterfall.py join of client
+    # stamps with the run's replica traces): segment -> {p50, p95, p99,
+    # count} in ms. Empty when the run had no trace dir.
+    latency_segments_ms: dict = dataclasses.field(default_factory=dict)
 
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self))
@@ -240,6 +250,7 @@ def run_native_config(
         # client retry keeps the round alive through it.
         handles[0].request_with_retry("warmup", timeout=600, retry_every=5)
         t0 = time.perf_counter()
+        t0_mono = time.monotonic()  # client stamps are monotonic-clock
 
         def drive(ci: int) -> None:
             handles[ci].request_many(
@@ -256,6 +267,39 @@ def run_native_config(
         for t in threads:
             t.join()
         elapsed = time.perf_counter() - t0
+        # Client-side latency stamps (ISSUE 9): reply latency percentiles
+        # from every worker's send->quorum records, warmup excluded; with
+        # a trace dir the client records also join against the replica
+        # traces into the per-request segment waterfall.
+        client_records = [
+            rec
+            for c in handles
+            for rec in c.latency_records()
+            if rec["send"] >= t0_mono
+        ]
+        reply_ms = sorted(
+            (rec["quorum"] - rec["send"]) * 1e3
+            for rec in client_records
+            if "quorum" in rec
+        )
+
+        def _pct(vals, q):
+            return vals[min(len(vals) - 1, int(q * len(vals)))] if vals else 0.0
+
+        latency_segments: dict = {}
+        if trace_dir:
+            from pathlib import Path as _Path
+
+            from ..utils import waterfall as wf_mod
+
+            for ci, c in enumerate(handles):
+                c.write_trace(str(_Path(trace_dir) / f"client-{ci}.jsonl"))
+            events = wf_mod.load_jsonl(
+                sorted(_Path(trace_dir).glob("replica-*.jsonl"))
+            )
+            latency_segments = wf_mod.build_waterfall(events, client_records)[
+                "segments_ms"
+            ]
         for c in handles:
             c.close()
         # Cluster-wide counters from each replica's last metrics line
@@ -311,6 +355,10 @@ def run_native_config(
         ),
         batch_max_items=batch_max_items,
         batch_flush_us=batch_flush_us,
+        reply_p50_ms=round(_pct(reply_ms, 0.5), 3),
+        reply_p95_ms=round(_pct(reply_ms, 0.95), 3),
+        reply_p99_ms=round(_pct(reply_ms, 0.99), 3),
+        latency_segments_ms=latency_segments,
     )
 
 

@@ -265,3 +265,66 @@ def check_spans(slots: Dict) -> List[str]:
                     f"seq {s0} at {t0:.6f} (out-of-order execution)"
                 )
     return problems
+
+
+def check_view_events(events) -> List[str]:
+    """Protocol-order invariants over the view-change span events
+    (view_timer_fired / view_change_sent / new_view_installed, ISSUE 9 —
+    the per-replica ordering consensus_timeline.py --check-invariants
+    enforces on real-cluster traces):
+
+    - a replica's first view_timer_fired precedes its first
+      new_view_installed (the span cannot close before it opened);
+    - view_change_sent toward view v precedes new_view_installed of v on
+      the same replica (sending is part of joining, when both exist —
+      a pure follower may install without ever sending);
+    - a replica's view_change_sent pending_view values are non-decreasing
+      over time (the floor rule: a replica never campaigns backwards).
+
+    ``events`` are trace-event dicts; returns problem strings (empty =
+    clean)."""
+    problems: List[str] = []
+    per: Dict[int, Dict[str, list]] = {}
+    for e in events:
+        ev = e.get("ev")
+        rid = e.get("replica")
+        ts = e.get("ts")
+        if not isinstance(rid, int) or not isinstance(ts, (int, float)):
+            continue
+        if ev == "view_timer_fired":
+            per.setdefault(rid, {}).setdefault("fired", []).append(ts)
+        elif ev == "view_change_sent":
+            per.setdefault(rid, {}).setdefault("sent", []).append(
+                (ts, e.get("pending_view"))
+            )
+        elif ev == "new_view_installed":
+            per.setdefault(rid, {}).setdefault("installed", []).append(
+                (ts, e.get("view"))
+            )
+    for rid, evs in per.items():
+        fired = sorted(evs.get("fired", []))
+        sent = sorted(evs.get("sent", []))
+        installed = sorted(evs.get("installed", []))
+        if fired and installed and installed[0][0] < fired[0]:
+            problems.append(
+                f"replica {rid}: new_view_installed at {installed[0][0]:.6f} "
+                f"precedes the first view_timer_fired at {fired[0]:.6f}"
+            )
+        first_sent: Dict[int, float] = {}
+        for ts, v in sent:
+            if isinstance(v, int) and v not in first_sent:
+                first_sent[v] = ts
+        for ts, v in installed:
+            if isinstance(v, int) and v in first_sent and ts < first_sent[v]:
+                problems.append(
+                    f"replica {rid}: installed view {v} at {ts:.6f} before "
+                    f"sending its view-change at {first_sent[v]:.6f}"
+                )
+        views = [v for _, v in sent if isinstance(v, int)]
+        for a, b in zip(views, views[1:]):
+            if b < a:
+                problems.append(
+                    f"replica {rid}: view_change_sent pending_view went "
+                    f"backwards ({a} -> {b})"
+                )
+    return problems

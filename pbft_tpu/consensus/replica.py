@@ -210,6 +210,13 @@ class Replica:
         # pre-prepare accept (feeds the pbft_batch_size histogram). Same
         # one-attribute-check-when-unset discipline as phase_hook.
         self.batch_hook: Optional[Callable[[int], None]] = None
+        # View-change observer (ISSUE 9, ROADMAP item 4): called with
+        # ("view_change_sent", pending_view) when this replica broadcasts
+        # VIEW-CHANGE and with ("new_view_installed", view) when it enters
+        # the new view. Rare reconfiguration events; the runtime stamps
+        # them into the matching trace events and the flight recorder.
+        # Same unset discipline as phase_hook.
+        self.view_hook: Optional[Callable[[str, int], None]] = None
         # The primary's OPEN batch (ISSUE 4): requests accumulated but not
         # yet sealed under a sequence number. _open_batch_ts tracks the
         # highest pending timestamp per client so duplicate suppression
@@ -765,6 +772,9 @@ class Replica:
         self.in_view_change = True
         self.pending_view = v
         self.counters["view_changes_started"] += 1
+        vh = self.view_hook
+        if vh is not None:
+            vh("view_change_sent", v)
         vc = self._sign(
             ViewChange(
                 new_view=v,
@@ -1050,6 +1060,9 @@ class Replica:
         self.pending_view = 0
         self._sealed_ts = {}  # per-view primary ordering memory
         self.counters["view_changes_completed"] += 1
+        vh = self.view_hook
+        if vh is not None:
+            vh("new_view_installed", v)
         for past in [w for w in self.view_changes if w <= v]:
             del self.view_changes[past]
         out: List[Action] = []

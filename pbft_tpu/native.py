@@ -26,7 +26,7 @@ _lib: Optional[ctypes.CDLL] = None
 # link against the shared library.
 _LIB_SOURCES = [
     "blake2b.cc", "sha512.cc", "ed25519.cc", "json.cc", "messages.cc",
-    "metrics.cc", "replica.cc", "verifier.cc", "verify_pool.cc",
+    "metrics.cc", "flight.cc", "replica.cc", "verifier.cc", "verify_pool.cc",
     "secure.cc", "net.cc", "discovery.cc", "capi.cc",
 ]
 
@@ -213,6 +213,42 @@ def pubkey_cache_disable(on: bool) -> None:
     """TEST hook: force the cold (uncached) pubkey-decompression path so
     parity tests can compare warm vs cold verdicts."""
     lib().pbft_test_pubkey_cache_disable(ctypes.c_int(1 if on else 0))
+
+
+def flight_configure(capacity: int) -> None:
+    """(Re)size + enable the native black-box flight recorder ring
+    (core/flight.cc); capacity 0 disables it."""
+    lib().pbft_flight_configure(ctypes.c_size_t(capacity))
+
+
+def flight_record(ev: int, view: int = 0, seq: int = 0, peer: int = -1) -> None:
+    """Record one event into the native ring (trace_schema.FLIGHT_EVENTS
+    ids) — a no-op (one branch) while the recorder is disabled."""
+    lib().pbft_flight_record(
+        ctypes.c_int(ev),
+        ctypes.c_longlong(view),
+        ctypes.c_longlong(seq),
+        ctypes.c_int(peer),
+    )
+
+
+def flight_total() -> int:
+    """Total records the native ring ever accepted (not capacity-clamped)."""
+    fn = lib().pbft_flight_total
+    fn.restype = ctypes.c_ulonglong
+    return int(fn())
+
+
+def flight_dump(path: str) -> int:
+    """Write the native ring's binary dump; returns the record count
+    (-1 on failure). Decode with pbft_tpu.utils.flight.decode_file."""
+    fn = lib().pbft_flight_dump
+    fn.restype = ctypes.c_long
+    return int(fn(str(path).encode()))
+
+
+def flight_reset() -> None:
+    lib().pbft_flight_reset()
 
 
 def message_to_binary(payload: bytes) -> Optional[bytes]:

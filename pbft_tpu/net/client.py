@@ -51,6 +51,7 @@ class PbftClient:
         class Handler(socketserver.StreamRequestHandler):
             def handle(self):
                 data = self.rfile.read()
+                rx = time.monotonic()  # arrival stamp for first-reply latency
                 for line in data.splitlines():
                     line = line.strip()
                     if not line:
@@ -59,6 +60,8 @@ class PbftClient:
                         reply = json.loads(line)
                     except json.JSONDecodeError:
                         continue
+                    if isinstance(reply, dict):
+                        reply["_rx"] = rx
                     with client._new_reply:
                         client.replies.append(reply)
                         client._new_reply.notify_all()
@@ -78,6 +81,19 @@ class PbftClient:
         )
         self._thread.start()
         self._timestamp = 0
+        # Per-request latency stamps (ISSUE 9 waterfall, client side):
+        # timestamp -> {send, first_reply, quorum} monotonic stamps —
+        # comparable with replica trace stamps on one host. Written by the
+        # request paths and wait_result; exported by latency_records() /
+        # write_trace() for the waterfall join.
+        self.latency_log: Dict[int, dict] = {}
+
+    def _stamp_send(self, timestamp: int) -> None:
+        # First send only: a retransmission must not erase the queueing
+        # delay it is there to measure.
+        self.latency_log.setdefault(timestamp, {}).setdefault(
+            "send", time.monotonic()
+        )
 
     def close(self) -> None:
         self.server.shutdown()
@@ -99,6 +115,7 @@ class PbftClient:
             operation=operation, timestamp=timestamp, client=self.address
         )
         ident = self.config.identity(to_replica)
+        self._stamp_send(timestamp)
         with socket.create_connection((ident.host, ident.port), timeout=5) as s:
             s.sendall(req.canonical() + b"\n")
         return req
@@ -140,6 +157,7 @@ class PbftClient:
                         timestamp=ts,
                         client=self.address,
                     )
+                    self._stamp_send(ts)
                     sock.sendall(req.canonical() + b"\n")
                     timestamps.append(ts)
                     inflight.append((ts, operations[next_op]))
@@ -204,6 +222,7 @@ class PbftClient:
         ts = self._timestamp
         req = ClientRequest(operation=operation, timestamp=ts, client=self.address)
         payload = req.canonical() + b"\n"
+        self._stamp_send(ts)
 
         def send_to(rid: int) -> None:
             ident = self.config.identity(rid)
@@ -237,6 +256,49 @@ class PbftClient:
                 send_to(attempt % self.config.n)
                 for rid in range(self.config.n):
                     send_to(rid)
+
+    # -- latency export (ISSUE 9 waterfall, client side) ---------------------
+
+    def latency_records(self) -> List[dict]:
+        """Per-request stamp records for the waterfall join:
+        {client, req_ts, send[, first_reply, quorum]}, send order."""
+        out = []
+        for ts in sorted(self.latency_log):
+            rec = self.latency_log[ts]
+            if "send" not in rec:
+                continue
+            row = {"client": self.address, "req_ts": ts, "send": rec["send"]}
+            for k in ("first_reply", "quorum"):
+                if k in rec:
+                    row[k] = rec[k]
+            out.append(row)
+        return out
+
+    def write_trace(self, path: str) -> int:
+        """Append one ``client_request`` JSONL event per completed stamp
+        record (schema: utils/trace_schema.py) so
+        ``scripts/consensus_timeline.py --waterfall`` can join client and
+        replica traces from one directory. Returns the event count."""
+        from ..utils.trace import Tracer
+
+        n = 0
+        with open(path, "a") as fh:
+            tracer = Tracer(fh)
+            for row in self.latency_records():
+                extra = {
+                    k: round(row[k], 6)
+                    for k in ("first_reply", "quorum")
+                    if k in row
+                }
+                tracer.event(
+                    "client_request",
+                    client=row["client"],
+                    req_ts=row["req_ts"],
+                    send=round(row["send"], 6),
+                    **extra,
+                )
+                n += 1
+        return n
 
     def _reply_signature_valid(self, r: dict, rid: int) -> bool:
         """Check the reply's Ed25519 signature against the configured
@@ -287,6 +349,18 @@ class PbftClient:
                     by_result[key] = by_result.get(key, 0) + 1
                 for (result, _view), count in by_result.items():
                     if count >= f + 1:
+                        # getattr: bare test doubles skip __init__.
+                        rec = getattr(self, "latency_log", {}).get(timestamp)
+                        if rec is not None and "quorum" not in rec:
+                            rec["quorum"] = time.monotonic()
+                            rxs = [
+                                r["_rx"]
+                                for r in self.replies
+                                if r.get("timestamp") == timestamp
+                                and "_rx" in r
+                            ]
+                            if rxs:
+                                rec["first_reply"] = min(rxs)
                         return result
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:

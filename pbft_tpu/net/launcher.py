@@ -57,6 +57,7 @@ class LocalCluster:
         config: Optional[ClusterConfig] = None,
         seeds: Optional[List[bytes]] = None,
         trace_dir: Optional[str] = None,
+        flight_dir: Optional[str] = None,
         byzantine: Optional[List[int]] = None,
         secure: bool = False,
         verify_flush_us: int = 0,
@@ -70,6 +71,11 @@ class LocalCluster:
         chaos_seed: Optional[int] = None,
     ):
         self.trace_dir = trace_dir
+        # Black-box flight recorders (ISSUE 9): each daemon dumps its last
+        # N protocol events to {flight_dir}/replica-{i}.flight on
+        # SIGTERM/fatal — kill() therefore ships the dead replica's black
+        # box (decode with scripts/flight_dump.py).
+        self.flight_dir = flight_dir
         # Request batching (ISSUE 4): scalars land in network.json; lists
         # become per-replica --batch-* CLI overrides (e.g. a batching
         # primary among batch=1 peers for the mixed-mode interop test).
@@ -192,6 +198,12 @@ class LocalCluster:
                 cmd += ["--discovery", self._discovery_target]
             if self.trace_dir:
                 cmd += ["--trace", str(Path(self.trace_dir) / f"replica-{i}.jsonl")]
+            if self.flight_dir:
+                Path(self.flight_dir).mkdir(parents=True, exist_ok=True)
+                cmd += [
+                    "--flight-file",
+                    str(Path(self.flight_dir) / f"replica-{i}.flight"),
+                ]
             if i in self.byzantine:
                 cmd += ["--byzantine"]
             if self.faults.get(i):

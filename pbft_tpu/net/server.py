@@ -154,6 +154,7 @@ class AsyncReplicaServer:
         chaos_delay_ms: int = 0,
         chaos_seed: Optional[int] = None,
         metrics_port: Optional[int] = None,
+        flight=None,
     ):
         self.config = config
         self.id = replica_id
@@ -171,13 +172,37 @@ class AsyncReplicaServer:
         self.metrics_port = metrics_port
         self._metrics_server = None
         self.metrics_listen_port = 0
+        # Black-box flight recorder (ISSUE 9, utils/flight.py): the last N
+        # protocol events in a bounded ring, dumped on SIGTERM/fatal (the
+        # runner installs the handler — see main()). None = one attribute
+        # check per event site, like the tracer.
+        self.flight = flight
         if self.metrics_registry.enabled or get_tracer().enabled:
             self.spans = ConsensusSpans(
                 self.metrics_registry, tracer=get_tracer(), replica=replica_id
             )
-            self.replica.phase_hook = self.spans.on_phase
+            if flight is not None:
+                _spans_hook = self.spans.on_phase
+                _flight_hook = flight.record_phase
+
+                def _phase(phase, view, seq):
+                    _flight_hook(phase, view, seq)
+                    _spans_hook(phase, view, seq)
+
+                self.replica.phase_hook = _phase
+            else:
+                self.replica.phase_hook = self.spans.on_phase
         else:
             self.spans = None
+            if flight is not None:
+                self.replica.phase_hook = flight.record_phase
+        # View-change spans (ROADMAP item 4): view_change_sent /
+        # new_view_installed are rare reconfiguration events — the hook is
+        # always wired; the tracer/flight checks inside gate the cost.
+        self.replica.view_hook = self._on_view_event
+        # When the primary's open batch first became non-empty (monotonic)
+        # — the "batch wait" waterfall segment measured at seal time.
+        self._batch_open_since: Optional[float] = None
         if self.metrics_registry.enabled:
             # Batch occupancy at every pre-prepare accept (ISSUE 4).
             _batch_hist = self.metrics_registry.histogram("pbft_batch_size")
@@ -467,10 +492,38 @@ class AsyncReplicaServer:
                 continue
             self._ingest(msg, payload)
 
+    def _on_view_event(self, ev: str, v: int) -> None:
+        """Replica.view_hook target: stamp view-change span events."""
+        if self.flight is not None:
+            self.flight.record(ev, view=v)
+        tracer = get_tracer()
+        if not tracer.enabled:
+            return
+        if ev == "view_change_sent":
+            tracer.event("view_change_sent", replica=self.id, pending_view=v)
+        else:
+            tracer.event("new_view_installed", replica=self.id, view=v)
+
     def _ingest(self, msg: Message, payload: Optional[bytes] = None) -> None:
         self.frames_in += 1
         if self.metrics_registry.enabled:
             self.metrics_registry.counter("pbft_frames_in_total").inc()
+        if isinstance(msg, ClientRequest):
+            # Request-level waterfall anchor (ISSUE 9): when this replica
+            # first saw the request — on the primary, the start of the
+            # client-queue -> batch-wait handoff.
+            if self.flight is not None:
+                self.flight.record(
+                    "request_rx", view=self.replica.view, seq=msg.timestamp
+                )
+            tracer = get_tracer()
+            if tracer.enabled:
+                tracer.event(
+                    "request_rx",
+                    replica=self.id,
+                    client=msg.client,
+                    req_ts=msg.timestamp,
+                )
         if payload is not None and not isinstance(msg, ClientRequest):
             # Receive-side canonical reuse: derive the signable digest
             # from the framed bytes (sig-splice for JSON; the binary path
@@ -484,13 +537,16 @@ class AsyncReplicaServer:
             actions = self.replica.receive(msg)
         if actions:
             self._emit(actions)
-        if (
-            self.replica.open_batch_size() > 0
-            and self._batch_flush_handle is None
-        ):
-            self._batch_flush_handle = asyncio.get_running_loop().call_later(
-                self.config.batch_flush_us / 1e6, self._flush_open_batch
-            )
+        if self.replica.open_batch_size() > 0:
+            if self._batch_open_since is None:
+                self._batch_open_since = time.monotonic()
+            if self._batch_flush_handle is None:
+                self._batch_flush_handle = (
+                    asyncio.get_running_loop().call_later(
+                        self.config.batch_flush_us / 1e6,
+                        self._flush_open_batch,
+                    )
+                )
         self._batch_wakeup.set()
 
     def _flush_open_batch(self) -> None:
@@ -554,6 +610,13 @@ class AsyncReplicaServer:
                 self.metrics_registry.gauge("pbft_verify_inflight_age_seconds").set(
                     round(secs, 6)
                 )
+            if self.flight is not None:
+                self.flight.record(
+                    "verify_batch",
+                    view=self.replica.view,
+                    seq=len(items),
+                    peer=verdicts.count(False),
+                )
             tracer = get_tracer()
             if tracer.enabled:  # batch boundaries only — never per message
                 tracer.event(
@@ -596,11 +659,39 @@ class AsyncReplicaServer:
             if dest != self.id:
                 loop.create_task(self._send_to(dest, enc))
 
+    def _trace_batch_sealed(self, pp: PrePrepare) -> None:
+        """The primary sealed a batch (its own pre-prepare broadcast):
+        emit the waterfall join record — (view, seq) plus the ordered
+        [client, req_ts] keys and how long the batch waited open."""
+        wait = 0.0
+        if self._batch_open_since is not None:
+            wait = max(0.0, time.monotonic() - self._batch_open_since)
+            self._batch_open_since = None
+        tracer = get_tracer()
+        if tracer.enabled:
+            tracer.event(
+                "batch_sealed",
+                replica=self.id,
+                view=pp.view,
+                seq=pp.seq,
+                batch=len(pp.requests),
+                wait_s=round(wait, 6),
+                reqs=[[r.client, r.timestamp] for r in pp.requests],
+            )
+
     def _emit(self, actions: List) -> None:
         loop = asyncio.get_running_loop()
         mute = self.fault == "mute"
         for act in actions:
             if isinstance(act, Broadcast):
+                if (
+                    isinstance(act.msg, PrePrepare)
+                    and act.msg.replica == self.id
+                ):
+                    # Seal observed BEFORE the fault modes: even a muted
+                    # or equivocating primary sealed locally. (The flight
+                    # record comes from the "request" phase transition.)
+                    self._trace_batch_sealed(act.msg)
                 if mute:  # receives but never sends (--fault mute)
                     self._count_fault()
                     continue
@@ -668,6 +759,19 @@ class AsyncReplicaServer:
                 if mute:  # a mute replica never dials the client back
                     self._count_fault()
                     continue
+                if self.flight is not None:
+                    self.flight.record(
+                        "reply_tx", view=act.msg.view, seq=act.msg.timestamp
+                    )
+                tracer = get_tracer()
+                if tracer.enabled:
+                    tracer.event(
+                        "reply_tx",
+                        replica=self.id,
+                        client=act.msg.client,
+                        req_ts=act.msg.timestamp,
+                        view=act.msg.view,
+                    )
                 loop.create_task(self._dial_reply(act.client, act.msg))
         if self.metrics_registry.enabled:
             # Deltas of the replica's own counters: "executed" counts per
@@ -946,6 +1050,20 @@ class AsyncReplicaServer:
                 self._timer_backoff = min(self._timer_backoff * 2, 64)
                 if self.metrics_registry.enabled:
                     self.metrics_registry.counter("pbft_view_changes_total").inc()
+                # The view-change span opens here (ROADMAP item 4):
+                # timer fired -> view_change_sent -> new_view_installed.
+                if self.flight is not None:
+                    self.flight.record(
+                        "view_timer_fired",
+                        view=self.replica.view,
+                        seq=self._timer_backoff,
+                    )
+                get_tracer().event(
+                    "view_timer_fired",
+                    replica=self.id,
+                    view=self.replica.view,
+                    backoff=self._timer_backoff,
+                )
                 get_tracer().event(
                     "view_change_start",
                     replica=self.id,
@@ -983,7 +1101,7 @@ class AsyncReplicaServer:
         }
 
 
-async def _amain(args, config_text: str) -> None:
+async def _amain(args, config_text: str, flight=None) -> None:
     # config_text is read by main() BEFORE the event loop starts: file
     # I/O inside a coroutine is a blocking call on the loop (flagged by
     # pbft_tpu/analysis/async_blocking.py, scripts/pbft_lint.py).
@@ -1008,6 +1126,7 @@ async def _amain(args, config_text: str) -> None:
         chaos_delay_ms=args.chaos_delay_ms,
         chaos_seed=args.chaos_seed,
         metrics_port=args.metrics_port,
+        flight=flight,
     )
     await server.start()
     print(
@@ -1095,14 +1214,34 @@ def main() -> None:
         "drop/delay pattern",
     )
     parser.add_argument("--trace", default=None, help="JSONL trace file")
+    parser.add_argument(
+        "--flight-file",
+        default=None,
+        help="black-box flight recorder dump target: the last N protocol "
+        "events, written on SIGTERM/SIGINT/fatal (decode with "
+        "scripts/flight_dump.py); mirrors pbftd --flight-file",
+    )
     args = parser.parse_args()
     if args.trace:
         from ..utils import set_trace_file
 
         set_trace_file(args.trace)
+    flight = None
+    if args.flight_file:
+        from ..utils.flight import FlightRecorder, install_signal_dump
+
+        flight = FlightRecorder(capacity=8192)
+        install_signal_dump(flight, args.flight_file)
     with open(args.config) as fh:
         config_text = fh.read()
-    asyncio.run(_amain(args, config_text))
+    try:
+        asyncio.run(_amain(args, config_text, flight=flight))
+    except BaseException:
+        # Fatal path (unhandled exception, loop torn down): the black box
+        # must still ship — same contract as pbftd's on_fatal handler.
+        if flight is not None:
+            flight.dump(args.flight_file)
+        raise
 
 
 if __name__ == "__main__":

@@ -9,11 +9,13 @@ discipline (utils/metrics.py). Event/metric names are contracted across
 both runtimes by utils/trace_schema.py.
 """
 
+from .flight import FlightRecorder
 from .metrics import ConsensusSpans, MetricsRegistry, start_metrics_server
 from .trace import Tracer, get_tracer, set_trace_file
 
 __all__ = [
     "ConsensusSpans",
+    "FlightRecorder",
     "MetricsRegistry",
     "Tracer",
     "get_tracer",

@@ -59,6 +59,62 @@ EVENT_SCHEMAS = {
         "optional": set(),
         "emitters": {"net.cc"},
     },
+    # -- request-level latency waterfall (ISSUE 9) --------------------------
+    #
+    # Requests are uniquely keyed by (client, req_ts) and batches by
+    # (view, seq); batch_sealed carries the [client, req_ts] pairs it
+    # sealed, so client-side send/recv stamps join to replica-side
+    # consensus spans purely in post-processing — zero wire changes
+    # (scripts/consensus_timeline.py --waterfall).
+    "request_rx": {
+        "required": {"ts", "ev", "replica", "client", "req_ts"},
+        "optional": set(),
+        "emitters": {"server.py", "net.cc"},
+    },
+    # The primary sealed its open batch under a sequence number. wait_s is
+    # how long the first request sat in the open batch (the "batch wait"
+    # waterfall segment); reqs is the ordered [[client, req_ts], ...] join
+    # key list.
+    "batch_sealed": {
+        "required": {"ts", "ev", "replica", "view", "seq", "batch", "wait_s"},
+        "optional": {"reqs"},
+        "emitters": {"server.py", "net.cc"},
+    },
+    "reply_tx": {
+        "required": {"ts", "ev", "replica", "client", "req_ts", "view"},
+        "optional": set(),
+        "emitters": {"server.py", "net.cc"},
+    },
+    # -- view-change spans (ROADMAP item 4) ---------------------------------
+    #
+    # view_timer_fired (the runtime's progress timer expired) ->
+    # view_change_sent (the replica broadcast VIEW-CHANGE toward
+    # pending_view) -> new_view_installed (it entered the view). Ordering
+    # is machine-checked by consensus_timeline.py --check-invariants
+    # (consensus/invariants.py check_view_events).
+    "view_timer_fired": {
+        "required": {"ts", "ev", "replica", "view", "backoff"},
+        "optional": set(),
+        "emitters": {"server.py", "net.cc"},
+    },
+    "view_change_sent": {
+        "required": {"ts", "ev", "replica", "pending_view"},
+        "optional": set(),
+        "emitters": {"server.py", "net.cc"},
+    },
+    "new_view_installed": {
+        "required": {"ts", "ev", "replica", "view"},
+        "optional": set(),
+        "emitters": {"server.py", "net.cc"},
+    },
+    # Client-side half of the waterfall (net/client.py write_trace): send /
+    # first-reply / f+1-quorum monotonic stamps per (client, req_ts).
+    # Comparable to replica stamps on one host (CLOCK_MONOTONIC).
+    "client_request": {
+        "required": {"ts", "ev", "client", "req_ts", "send"},
+        "optional": {"first_reply", "quorum"},
+        "emitters": {"client.py"},
+    },
 }
 
 # -- metrics (Prometheus text format at --metrics-port) ---------------------
@@ -158,6 +214,37 @@ BATCH_SIZE_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096)
 # The consensus phases in protocol order. "request" exists only on the
 # primary (it assigns the sequence number); every replica sees the rest.
 PHASES = ("request", "pre_prepare", "prepared", "committed", "executed")
+
+# -- black-box flight recorder (ISSUE 9) -------------------------------------
+#
+# Both runtimes keep a fixed-size ring of compact binary records
+# (core/flight.{h,cc} lock-free atomics; pbft_tpu/utils/flight.py a
+# bounded deque) dumped to a file on SIGTERM/fatal/invariant-failure and
+# decoded by scripts/flight_dump.py. The on-disk format is shared:
+#
+#   header  FLIGHT_MAGIC (8B) + u32le version + u32le record count
+#   record  u64le t_ns, u16le event id, i16le peer, i32le view, i32le seq
+#
+# Event ids are the cross-runtime contract below; core/flight.h mirrors
+# them (enum FlightEvent). The "request" consensus phase records as
+# batch_sealed (the primary's sequence assignment IS the seal).
+FLIGHT_MAGIC = b"PBFTBBX1"
+FLIGHT_VERSION = 1
+FLIGHT_RECORD_SIZE = 20
+FLIGHT_EVENTS = {
+    1: "request_rx",
+    2: "batch_sealed",
+    3: "pre_prepare",
+    4: "prepared",
+    5: "committed",
+    6: "executed",
+    7: "reply_tx",
+    8: "view_timer_fired",
+    9: "view_change_sent",
+    10: "new_view_installed",
+    11: "verify_batch",
+}
+FLIGHT_EVENT_IDS = {name: i for i, name in FLIGHT_EVENTS.items()}
 
 # phase-transition -> the latency histogram it feeds (observed at
 # "executed" time from the span's stamps).

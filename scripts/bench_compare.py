@@ -31,7 +31,13 @@ DEFAULT_METRICS = (
     "requests_per_sec",
     "sig_verifies_per_sec",
     "value",  # bench.py single-line result (verifies/sec)
+    "reply_p99_ms",  # client-observed p99 reply latency (ISSUE 9)
 )
+
+# Default-gated metrics where SMALLER is the improvement: p99 reply
+# latency regresses by going UP even when throughput holds (a batching
+# knob can buy requests/sec with tail latency — the gate must see both).
+DEFAULT_LOWER_BETTER = frozenset({"reply_p99_ms"})
 
 
 def load_runs(path: str) -> List[dict]:
@@ -146,7 +152,8 @@ def main(argv=None) -> int:
         "--lower-better",
         action="append",
         default=[],
-        help="metrics where smaller is an improvement (e.g. latency)",
+        help="metrics where smaller is an improvement (e.g. latency); "
+        "reply_p99_ms is treated as lower-better by default",
     )
     parser.add_argument(
         "--json", action="store_true", help="machine-readable report"
@@ -166,7 +173,7 @@ def main(argv=None) -> int:
         metrics,
         args.max_regress_pct,
         agg=args.agg,
-        lower_better=frozenset(args.lower_better),
+        lower_better=DEFAULT_LOWER_BETTER | frozenset(args.lower_better),
     )
     if not report:
         print(

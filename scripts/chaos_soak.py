@@ -29,9 +29,10 @@ Usage:
 from __future__ import annotations
 
 import argparse
+import os
 import pathlib
 import sys
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
@@ -41,6 +42,7 @@ from pbft_tpu.consensus.invariants import (  # noqa: E402
     InvariantViolation,
 )
 from pbft_tpu.consensus.simulation import Cluster  # noqa: E402
+from pbft_tpu.utils.flight import FlightRecorder  # noqa: E402
 
 # Scheduler rounds of zero progress before the soak fires the replicas'
 # view-change timers (the sim has no wall clock; this is its vc_timeout).
@@ -71,6 +73,37 @@ def _pick_verifier():
     return "cpu"
 
 
+def _wire_flight(cluster: Cluster) -> Dict[int, FlightRecorder]:
+    """One black-box flight recorder per sim replica: the phase/view
+    hooks feed it the same protocol events the real daemons record
+    (utils/flight.py), so a failing seed ships every replica's last
+    moments — crashed replicas included, their rings are frozen in
+    memory exactly where the crash left them."""
+    recorders: Dict[int, FlightRecorder] = {}
+    for r in cluster.replicas:
+        rec = FlightRecorder(capacity=2048)
+        recorders[r.id] = rec
+        r.phase_hook = rec.record_phase
+        r.view_hook = (
+            lambda ev, v, _rec=rec: _rec.record(ev, view=v)
+        )
+    return recorders
+
+
+def _dump_flight(
+    recorders: Dict[int, FlightRecorder], flight_dir: str, seed: int, n: int
+) -> List[str]:
+    os.makedirs(flight_dir, exist_ok=True)
+    paths = []
+    for rid in sorted(recorders):
+        path = os.path.join(
+            flight_dir, f"seed{seed}-n{n}-replica-{rid}.flight"
+        )
+        recorders[rid].dump(path)
+        paths.append(path)
+    return paths
+
+
 def run_one(
     seed: int,
     n: int,
@@ -79,10 +112,12 @@ def run_one(
     submit_every: int = 6,
     recovery_steps: int = 400,
     verbose: bool = False,
+    flight_dir: Optional[str] = None,
 ) -> dict:
     """One soak run. Returns {ok, seed, n, violation?, schedule, ...}."""
     cluster = Cluster(n=n, seed=seed, shuffle=True, verifier=_pick_verifier(),
                       app=_echo_app)
+    recorders = _wire_flight(cluster) if flight_dir else {}
     checker = InvariantChecker(cluster)
     if schedule is None:
         schedule = random_schedule(seed, n, steps)
@@ -176,6 +211,13 @@ def run_one(
             cluster.trigger_view_change(new_view=target)
         return None
 
+    def with_black_box(res: dict) -> dict:
+        # A failing seed ships its black boxes: one flight dump per
+        # replica (decode: python scripts/flight_dump.py <file>).
+        if recorders:
+            res["flight_dumps"] = _dump_flight(recorders, flight_dir, seed, n)
+        return res
+
     op_counter = 0
 
     def submit_next() -> None:
@@ -199,20 +241,20 @@ def run_one(
             submit_next()
         fail = tick(t, in_recovery=False)
         if fail is not None:
-            return fail
+            return with_black_box(fail)
         refresh_pending()
     # Recovery phase: the schedule's trailing cleanup healed partitions,
     # revived crashes, and cleared faults — L1 must now converge.
     for t in range(steps + 1, steps + 1 + recovery_steps):
         fail = tick(t, in_recovery=True)
         if fail is not None:
-            return fail
+            return with_black_box(fail)
         refresh_pending()
         if not checker.unreplied(submitted):
             break
     missing = checker.unreplied(submitted)
     if missing:
-        return {
+        return with_black_box({
             "ok": False,
             "seed": seed,
             "n": n,
@@ -222,7 +264,7 @@ def run_one(
             % (len(missing), len(submitted),
                [r.timestamp for r in missing[:8]]),
             "schedule": schedule,
-        }
+        })
     return {
         "ok": True,
         "seed": seed,
@@ -271,6 +313,10 @@ def _print_failure(res: dict) -> None:
         "  replay: python scripts/chaos_soak.py --replay %d --n %d "
         "--steps %d" % (res["seed"], res["n"], res.get("steps", 0) or 0)
     )
+    if res.get("flight_dumps"):
+        print("  black boxes (decode: python scripts/flight_dump.py FILE):")
+        for p in res["flight_dumps"]:
+            print(f"    {p}")
 
 
 def main(argv=None) -> int:
@@ -289,6 +335,11 @@ def main(argv=None) -> int:
     parser.add_argument("--validate", action="store_true",
                         help="checker validity: f+1 faulty must trip safety")
     parser.add_argument("--submit-every", type=int, default=6)
+    parser.add_argument(
+        "--flight-dir", default="chaos-blackbox",
+        help="directory for per-replica flight-recorder dumps on failure "
+        "(the black box; decode with scripts/flight_dump.py). Empty "
+        "string disables.")
     args = parser.parse_args(argv)
     sizes = [int(s) for s in args.n.split(",") if s]
 
@@ -307,7 +358,8 @@ def main(argv=None) -> int:
         for n in sizes:
             print(f"replaying seed {args.replay} n={n} steps={args.steps}:")
             res = run_one(args.replay, n, args.steps,
-                          submit_every=args.submit_every, verbose=True)
+                          submit_every=args.submit_every, verbose=True,
+                          flight_dir=args.flight_dir or None)
             if res["ok"]:
                 print(f"  OK: {res['submitted']} requests, "
                       f"executed up to {res['executed']}, "
@@ -323,7 +375,8 @@ def main(argv=None) -> int:
     for i in range(args.seeds):
         seed = args.seed_base + i
         for n in sizes:
-            res = run_one(seed, n, args.steps, submit_every=args.submit_every)
+            res = run_one(seed, n, args.steps, submit_every=args.submit_every,
+                          flight_dir=args.flight_dir or None)
             if res["ok"]:
                 print(f"seed {seed:>3} n={n}: OK  "
                       f"({res['submitted']} reqs, exec<={res['executed']}, "

@@ -36,15 +36,45 @@ import pathlib
 import sys
 
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent))
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 from trace_report import expand_trace_args, load  # noqa: E402
 
 PHASE_ORDER = ("request", "pre_prepare", "prepared", "committed", "executed")
+
+# View-change span events (ISSUE 9): collected per replica for the
+# waterfall report and the --check-invariants ordering checks.
+VIEW_EVENTS = ("view_timer_fired", "view_change_sent", "new_view_installed")
 
 
 def _replica_of(e) -> object:
     """Numeric replica id, or None for non-replica emitters ("service")."""
     rid = e.get("replica")
     return rid if isinstance(rid, int) else None
+
+
+def collect_events(files, names) -> list:
+    """Every event with ``ev`` in ``names``, merged across files."""
+    out = []
+    for path in files:
+        for e in load(path):
+            if e.get("ev") in names:
+                out.append(e)
+    return out
+
+
+def batch_sizes(files) -> dict:
+    """{(view, seq) -> sealed batch size} from batch_sealed events —
+    the per-slot occupancy that turns per-ROUND segment times into
+    per-REQUEST attribution (spans are per (view, seq) since the batched
+    agreement PR; a report that labels them as single requests
+    overstates per-request cost by the batch factor)."""
+    sizes: dict = {}
+    for e in collect_events(files, ("batch_sealed",)):
+        try:
+            sizes[(int(e["view"]), int(e["seq"]))] = int(e["batch"])
+        except (KeyError, TypeError, ValueError):
+            continue
+    return sizes
 
 
 def build_timeline(files) -> dict:
@@ -88,13 +118,27 @@ def build_timeline(files) -> dict:
     return slots
 
 
-def analyze(slots: dict, straggler_ms: float, gap_ms: float, spread: bool) -> dict:
-    """Per-slot breakdowns + cluster-level straggler/gap summary."""
+def analyze(
+    slots: dict,
+    straggler_ms: float,
+    gap_ms: float,
+    spread: bool,
+    batches: dict = None,
+) -> dict:
+    """Per-slot breakdowns + cluster-level straggler/gap summary.
+
+    ``batches`` ((view, seq) -> sealed size, from batch_sizes) attributes
+    each slot to its real request count: slots gain a "batch" field and
+    per-request amortized execute time, and the summary reports the mean
+    batch per window — a batched round is NOT one request."""
+    batches = batches or {}
     replicas = sorted({r for per in slots.values() for r in per})
     breakdown = []
     for (view, seq) in sorted(slots):
         per = slots[(view, seq)]
         entry = {"view": view, "seq": seq, "replicas": {}}
+        if (view, seq) in batches:
+            entry["batch"] = batches[(view, seq)]
         for rid in sorted(per):
             stamps = per[rid]
             rep = {
@@ -162,12 +206,14 @@ def analyze(slots: dict, straggler_ms: float, gap_ms: float, spread: bool) -> di
     for entry in breakdown:
         for rid in entry.get("stragglers", ()):
             straggler_counts[str(rid)] = straggler_counts.get(str(rid), 0) + 1
+    sized = [e["batch"] for e in breakdown if "batch" in e]
     return {
         "slots": breakdown,
         "replicas": replicas,
         "coverage_gaps": gaps,
         "commit_stalls": stalls,
         "straggler_counts": straggler_counts,
+        "mean_batch": round(sum(sized) / len(sized), 2) if sized else None,
     }
 
 
@@ -184,6 +230,8 @@ def _ranges(seqs):
 
 def _fmt_slot(entry) -> str:
     parts = [f"(v={entry['view']}, n={entry['seq']})"]
+    if "batch" in entry:
+        parts.append(f"batch={entry['batch']}")
     if "executed_spread_ms" in entry:
         parts.append(f"spread={entry['executed_spread_ms']:.1f}ms")
     if entry.get("stragglers"):
@@ -223,8 +271,18 @@ def main(argv=None) -> dict:
         "--check-invariants",
         action="store_true",
         help="run the protocol-order invariants (consensus/invariants.py "
-        "check_spans) over the merged span data: phase monotonicity, "
-        "in-order execution, single-execution per sequence",
+        "check_spans + check_view_events) over the merged span data: "
+        "phase monotonicity, in-order execution, single-execution per "
+        "sequence, and view_timer_fired -> view_change_sent -> "
+        "new_view_installed ordering",
+    )
+    parser.add_argument(
+        "--waterfall",
+        action="store_true",
+        help="join client_request traces (net/client.py write_trace) with "
+        "replica request_rx/batch_sealed/consensus_span events into "
+        "per-request segment breakdowns with p50/p95/p99 per segment "
+        "(client queue, batch wait, prepared, committed, execute, reply)",
     )
     args = parser.parse_args(argv)
     files = expand_trace_args(args.traces)
@@ -233,14 +291,29 @@ def main(argv=None) -> dict:
     slots = build_timeline(files)
     if not slots:
         sys.exit("no consensus_span or executed-bearing verify_batch events")
+    batches = batch_sizes(files)
+    view_events = collect_events(files, VIEW_EVENTS)
     result = analyze(
-        slots, args.straggler_ms, args.gap_ms, spread=not args.no_spread
+        slots,
+        args.straggler_ms,
+        args.gap_ms,
+        spread=not args.no_spread,
+        batches=batches,
     )
-    if args.check_invariants:
-        sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
-        from pbft_tpu.consensus.invariants import check_spans
+    result["view_events"] = len(view_events)
+    if args.waterfall:
+        from pbft_tpu.utils import waterfall as wf_mod
 
-        result["invariant_problems"] = check_spans(slots)
+        events = wf_mod.load_jsonl(files)
+        result["waterfall"] = wf_mod.build_waterfall(
+            events, wf_mod.client_records_from_events(events)
+        )
+    if args.check_invariants:
+        from pbft_tpu.consensus.invariants import check_spans, check_view_events
+
+        result["invariant_problems"] = check_spans(slots) + check_view_events(
+            view_events
+        )
     if args.json:
         print(json.dumps(result, indent=1, sort_keys=True))
         return result
@@ -249,11 +322,21 @@ def main(argv=None) -> dict:
         f"{n} (view, seq) slots from {len(files)} trace files, "
         f"replicas={result['replicas']}"
     )
+    if result.get("mean_batch"):
+        print(
+            f"mean batch per sealed window: {result['mean_batch']} "
+            "(segment times below are per ROUND — a batched round "
+            "carries that many requests)"
+        )
     shown = result["slots"] if args.limit == 0 else result["slots"][: args.limit]
     for entry in shown:
         print("  " + _fmt_slot(entry))
     if n > len(shown):
         print(f"  ... {n - len(shown)} more slots (--limit 0 for all)")
+    if args.waterfall:
+        from pbft_tpu.utils import waterfall as wf_mod
+
+        print(wf_mod.render(result["waterfall"]))
     if result["straggler_counts"]:
         worst = sorted(
             result["straggler_counts"].items(), key=lambda kv: -kv[1]

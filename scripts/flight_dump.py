@@ -1,0 +1,81 @@
+#!/usr/bin/env python
+"""flight_dump — decode black-box flight-recorder dumps.
+
+Both runtimes keep a fixed-size ring of the last N protocol events
+(core/flight.cc in pbftd, pbft_tpu/utils/flight.py in the asyncio
+runtime and the chaos-soak simulator) and dump it on SIGTERM/fatal/
+invariant-failure. This tool turns a dump back into ordered, named
+protocol events — what the dead replica was doing in its final moments.
+
+    python scripts/flight_dump.py /tmp/pbft-flight/replica-2.flight
+    python scripts/flight_dump.py chaos-blackbox/*.flight --json
+    python scripts/flight_dump.py dump.flight --tail 50
+
+Record fields: t_ns (CLOCK_MONOTONIC), event, view, seq, peer. The seq
+slot is context-dependent: the sequence number for consensus phases, the
+client request timestamp for request_rx/reply_tx, the batch size for
+verify_batch, the timer backoff for view_timer_fired.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from pbft_tpu.utils.flight import decode_file  # noqa: E402
+
+
+def render(path: str, records, tail: int) -> None:
+    shown = records[-tail:] if tail else records
+    print(f"{path}: {len(records)} records"
+          + (f" (last {len(shown)})" if len(shown) < len(records) else ""))
+    if not records:
+        return
+    t0 = records[0]["t_ns"]
+    for r in shown:
+        extra = f" peer={r['peer']}" if r["peer"] >= 0 else ""
+        print(
+            "  +%12.3fms  %-20s v=%-4d seq=%d%s"
+            % ((r["t_ns"] - t0) / 1e6, r["event"], r["view"], r["seq"], extra)
+        )
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    parser.add_argument("dumps", nargs="+", help="*.flight dump files")
+    parser.add_argument("--json", action="store_true", help="machine output")
+    parser.add_argument(
+        "--tail", type=int, default=0,
+        help="only the last N records per dump (0 = all)")
+    args = parser.parse_args(argv)
+    rc = 0
+    out = {}
+    for path in args.dumps:
+        try:
+            records = decode_file(path)
+        except (OSError, ValueError) as e:
+            print(f"flight_dump: {path}: {e}", file=sys.stderr)
+            rc = 2
+            continue
+        if args.json:
+            out[path] = records
+        else:
+            render(path, records, args.tail)
+    if args.json:
+        print(json.dumps(out))
+    return rc
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:  # `flight_dump ... | head` closing stdout early
+        sys.stderr.close()
+        sys.exit(0)

@@ -46,21 +46,47 @@ _SPAN_PHASES = [
 ]
 
 
-def _span_summary(spans) -> str:
-    """One-line per-phase latency summary for consensus_span events."""
+def _batch_sizes(events) -> dict:
+    """{(view, seq) -> sealed batch size} from batch_sealed events."""
+    sizes = {}
+    for e in events:
+        if e.get("ev") != "batch_sealed":
+            continue
+        try:
+            sizes[(int(e["view"]), int(e["seq"]))] = int(e["batch"])
+        except (KeyError, TypeError, ValueError):
+            continue
+    return sizes
+
+
+def _span_summary(spans, batches=None) -> str:
+    """One-line per-phase latency summary for consensus_span events.
+
+    Spans are per (view, seq) — per ROUND — and a batched round carries
+    many requests (ISSUE 4), so segment times must not be read as
+    per-request numbers. When batch_sealed data is available the execute
+    segment (the only one whose cost scales with occupancy) also reports
+    its per-request amortization, and the caller prints the mean batch."""
+    batches = batches or {}
     parts = []
     for a, b in _SPAN_PHASES:
-        durs = sorted(
-            e[b] - e[a]
+        rows = [
+            (e[b] - e[a], batches.get((e.get("view"), e.get("seq")), 1))
             for e in spans
             if isinstance(e.get(a), (int, float))
             and isinstance(e.get(b), (int, float))
+        ]
+        if not rows:
+            continue
+        durs = sorted(r[0] for r in rows)
+        label = (
+            f"{b} p50={_pct(durs, 0.5) * 1e3:.2f}ms "
+            f"p90={_pct(durs, 0.9) * 1e3:.2f}ms"
         )
-        if durs:
-            parts.append(
-                f"{b} p50={_pct(durs, 0.5) * 1e3:.2f}ms "
-                f"p90={_pct(durs, 0.9) * 1e3:.2f}ms"
-            )
+        if b == "executed" and batches:
+            per_req = sorted(d / max(1, n) for d, n in rows)
+            label += f" ({_pct(per_req, 0.5) * 1e3:.2f}ms/req)"
+        parts.append(label)
     e2e = sorted(
         e["executed"] - (e.get("request", e.get("pre_prepare")))
         for e in spans
@@ -114,9 +140,23 @@ def report(files) -> dict:
         total["secs"] += sum(secs)
         total["vcs"] += len(vcs)
         total["spans"] += len(spans)
+        batches = _batch_sizes(events)
+        if batches:
+            sizes_b = list(batches.values())
+            total["sealed_windows"] = total.get("sealed_windows", 0) + len(
+                sizes_b
+            )
+            total["sealed_requests"] = total.get("sealed_requests", 0) + sum(
+                sizes_b
+            )
+            print(
+                f"{path.name}: {len(sizes_b)} sealed batches, mean batch "
+                f"{sum(sizes_b) / len(sizes_b):.2f}/window "
+                f"(spans below are per ROUND, not per request)"
+            )
         if spans:
             print(f"{path.name}: {len(spans)} consensus spans: "
-                  + _span_summary(spans))
+                  + _span_summary(spans, batches))
         if vb:
             span = vb[-1]["ts"] - vb[0]["ts"] or 1e-9
             print(
@@ -136,6 +176,13 @@ def report(files) -> dict:
             f"(batching-window efficiency), {total['rejected']} rejected, "
             f"{total['vcs']} view changes, "
             f"{total['secs']:.2f}s total verify time"
+        )
+    if total.get("sealed_windows"):
+        print(
+            f"cluster: {total['sealed_requests']} requests over "
+            f"{total['sealed_windows']} sealed windows = mean batch "
+            f"{total['sealed_requests'] / total['sealed_windows']:.2f} "
+            "(the round->request attribution factor)"
         )
     if total["spans"]:
         print(

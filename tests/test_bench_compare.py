@@ -127,3 +127,33 @@ def test_compare_api_median_is_robust_to_one_outlier():
 def test_load_runs_on_checked_in_history(name):
     runs = load_runs(os.path.join(BENCH, name))
     assert runs and all(isinstance(r, dict) for r in runs)
+
+
+def test_reply_p99_latency_gated_by_default(tmp_path):
+    """ISSUE 9: p99 reply latency is gated alongside throughput WITHOUT
+    extra flags — a run whose requests/sec holds but whose tail latency
+    doubles must fail, and an improving tail must pass."""
+    old = tmp_path / "old.jsonl"
+    worse = tmp_path / "worse.jsonl"
+    better = tmp_path / "better.jsonl"
+    base = {"requests_per_sec": 500.0, "reply_p99_ms": 40.0}
+    old.write_text(
+        "\n".join(
+            json.dumps({**base, "reply_p99_ms": 40.0 + i}) for i in range(3)
+        )
+    )
+    worse.write_text(
+        "\n".join(
+            json.dumps({**base, "reply_p99_ms": 90.0 + i}) for i in range(3)
+        )
+    )
+    better.write_text(
+        "\n".join(
+            json.dumps({**base, "reply_p99_ms": 20.0 + i}) for i in range(3)
+        )
+    )
+    res = run_cli(str(old), str(worse), "--max-regress-pct", "10")
+    assert res.returncode == 1, res.stdout + res.stderr
+    assert "reply_p99_ms" in res.stdout
+    res = run_cli(str(old), str(better), "--max-regress-pct", "10")
+    assert res.returncode == 0, res.stdout + res.stderr

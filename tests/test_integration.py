@@ -678,3 +678,57 @@ def test_kitchen_sink_mixed_secure_windowed_byzantine():
             assert rejected and int(rejected[-1]) > 0, "byzantine sigs unseen?"
         finally:
             client.close()
+
+
+def test_view_change_spans_mixed_cluster_muted_primary(tmp_path):
+    """View-change spans from a REAL mixed C++/Python cluster (ISSUE 9):
+    a muted primary forces the honest replicas' timers to fire; both
+    runtimes must emit view_timer_fired / view_change_sent /
+    new_view_installed trace events whose ordering
+    consensus_timeline.py --check-invariants certifies."""
+    import json
+    import pathlib
+    import sys
+
+    trace_dir = tmp_path / "traces"
+    trace_dir.mkdir()
+    with LocalCluster(
+        n=4,
+        verifier="cpu",
+        impl=["cxx", "py", "cxx", "py"],
+        vc_timeout_ms=400,
+        faults={0: "mute"},
+        trace_dir=str(trace_dir),
+    ) as cluster:
+        client = PbftClient(cluster.config)
+        try:
+            result = client.request_with_retry(
+                "through the mute", timeout=60, retry_every=1.0
+            )
+            assert result == "awesome!"
+        finally:
+            client.close()
+    sys.path.insert(0, str(pathlib.Path(__file__).parent.parent / "scripts"))
+    import consensus_timeline
+
+    res = consensus_timeline.main(
+        [str(trace_dir), "--check-invariants", "--json"]
+    )
+    assert res["invariant_problems"] == []
+    assert res["view_events"] >= 3
+    events = []
+    for p in sorted(trace_dir.glob("replica-*.jsonl")):
+        for line in p.read_text().splitlines():
+            try:
+                events.append(json.loads(line))
+            except ValueError:
+                pass
+    installed = {
+        e["replica"] for e in events if e.get("ev") == "new_view_installed"
+    }
+    # Both runtimes installed the new view: replica 2 is C++, replica 1
+    # (the new primary) and 3 are Python.
+    assert installed & {0, 2}, "no C++ replica reported new_view_installed"
+    assert installed & {1, 3}, "no Python replica reported new_view_installed"
+    fired = {e["replica"] for e in events if e.get("ev") == "view_timer_fired"}
+    assert fired, "no replica reported its timer firing"
