@@ -3,9 +3,12 @@
 // the pieces a pure-C++ build must guarantee on its own: crypto known
 // answers, canonical JSON, and a full in-process 4-replica consensus round
 // including a view change.
+#include <netinet/in.h>
+#include <poll.h>
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <chrono>
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
@@ -13,6 +16,7 @@
 #include <memory>
 #include <set>
 #include <string>
+#include <thread>
 #include <vector>
 
 #include "blake2b.h"
@@ -21,6 +25,7 @@
 #include "json.h"
 #include "messages.h"
 #include "metrics.h"
+#include "net.h"
 #include "replica.h"
 #include "secure.h"
 #include "sha512.h"
@@ -747,6 +752,106 @@ void test_remote_verifier_readiness() {
 
 }  // namespace
 
+// --- ISSUE 10: epoll-ET loop vs the poll() fallback ------------------------
+
+int parity_listen_ephemeral(int* port_out) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  pbft::tune_listen_socket(fd);
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  if (::bind(fd, (sockaddr*)&addr, sizeof(addr)) != 0 ||
+      ::listen(fd, 64) != 0) {
+    ::close(fd);
+    return -1;
+  }
+  socklen_t len = sizeof(addr);
+  ::getsockname(fd, (sockaddr*)&addr, &len);
+  *port_out = ntohs(addr.sin_port);
+  return fd;
+}
+
+// One real-socket 4-replica round: client request in, f+1 dial-back
+// replies observed, on whichever readiness backend the environment
+// selects. The PBFT_NET_POLL=1 arm proves the incrementally-maintained
+// poll() fallback is behaviorally identical to edge-triggered epoll.
+void parity_round(const char* want_backend) {
+  int ports[4];
+  int hold[4];
+  for (int i = 0; i < 4; ++i) {
+    hold[i] = parity_listen_ephemeral(&ports[i]);
+    CHECK(hold[i] >= 0);
+  }
+  pbft::ClusterConfig cfg;
+  std::vector<std::vector<uint8_t>> seeds;
+  for (int i = 0; i < 4; ++i) {
+    std::vector<uint8_t> seed(32, (uint8_t)(i + 41));
+    pbft::ReplicaIdentity ident;
+    ident.replica_id = i;
+    ident.host = "127.0.0.1";
+    ident.port = ports[i];
+    pbft::ed25519_public_key(ident.pubkey, seed.data());
+    cfg.replicas.push_back(ident);
+    seeds.push_back(seed);
+  }
+  for (int i = 0; i < 4; ++i) ::close(hold[i]);
+  std::vector<std::unique_ptr<pbft::ReplicaServer>> servers;
+  for (int i = 0; i < 4; ++i) {
+    servers.push_back(std::make_unique<pbft::ReplicaServer>(
+        cfg, i, seeds[i].data(), std::make_unique<pbft::CpuVerifier>()));
+    CHECK(servers[i]->start());
+    CHECK(std::string(servers[i]->net_backend()) == want_backend);
+  }
+  std::vector<std::thread> loops;
+  for (int i = 0; i < 4; ++i) {
+    loops.emplace_back([srv = servers[i].get()] { srv->run(); });
+  }
+  int reply_port = 0;
+  int reply_fd = parity_listen_ephemeral(&reply_port);
+  CHECK(reply_fd >= 0);
+  const std::string reply_addr = "127.0.0.1:" + std::to_string(reply_port);
+  const std::string req =
+      "{\"type\":\"client-request\",\"operation\":\"backend\","
+      "\"timestamp\":1,\"client\":\"" + reply_addr + "\"}\n";
+  int replies = 0;
+  auto deadline = std::chrono::steady_clock::now() + std::chrono::seconds(20);
+  int attempt = 0;
+  while (replies < 2 && std::chrono::steady_clock::now() < deadline) {
+    int fd = pbft::dial_tcp("127.0.0.1:" +
+                            std::to_string(ports[attempt++ % 4]));
+    if (fd >= 0) {
+      (void)!::send(fd, req.data(), req.size(), MSG_NOSIGNAL);
+      ::close(fd);
+    }
+    auto retry_at =
+        std::chrono::steady_clock::now() + std::chrono::milliseconds(400);
+    while (replies < 2 && std::chrono::steady_clock::now() < retry_at) {
+      pollfd pfd{reply_fd, POLLIN, 0};
+      if (::poll(&pfd, 1, 50) <= 0) continue;
+      int cfd = ::accept(reply_fd, nullptr, nullptr);
+      if (cfd < 0) continue;
+      char buf[512];
+      if (::recv(cfd, buf, sizeof(buf) - 1, 0) > 0) ++replies;
+      ::close(cfd);
+    }
+  }
+  CHECK(replies >= 2);  // f+1 distinct dial-backs observed
+  for (auto& s : servers) s->stop();
+  for (auto& t : loops) t.join();
+  for (auto& s : servers) CHECK(s->replica().executed_upto() >= 1);
+  ::close(reply_fd);
+}
+
+void test_net_backend_parity() {
+  ::setenv("PBFT_NET_POLL", "1", 1);
+  parity_round("poll");
+  ::unsetenv("PBFT_NET_POLL");
+#ifdef __linux__
+  parity_round("epoll-et");
+#endif
+}
+
 void test_flight_recorder() {
   pbft::FlightRecorder fl;
   // Disabled (unconfigured) recorder: record is a no-op, dump refuses.
@@ -804,6 +909,7 @@ int main() {
   test_verify_pool_native();
   test_remote_verifier_async();
   test_remote_verifier_readiness();
+  test_net_backend_parity();
   test_flight_recorder();
   if (g_failures) {
     std::fprintf(stderr, "%d failure(s)\n", g_failures);

@@ -36,6 +36,11 @@ const char* kCounterNames[] = {
     // coalescing dispatcher. Zero on a replica (eager registration keeps
     // the series set uniform across every runtime's scrape).
     "pbft_verify_service_launches_total",
+    // Scale-out surface (ISSUE 10): poller wait() returns, bounded-queue
+    // drops + partial-write episodes, requests received over gateway
+    // links.
+    "pbft_epoll_wakeups_total", "pbft_write_backpressure_events_total",
+    "pbft_gateway_forwarded_total",
 };
 const char* kGaugeNames[] = {
     "pbft_verify_queue_depth",
@@ -48,6 +53,9 @@ const char* kGaugeNames[] = {
     // reload). Zero on a replica.
     "pbft_verify_service_cold_compile_seconds",
     "pbft_verify_service_warm_compile_seconds",
+    // Scale-out surface (ISSUE 10): live sockets (accepted + dialed),
+    // refreshed by the end-of-iteration sweep.
+    "pbft_connections_open",
 };
 // name -> uses the size bucket ladder (else latency).
 const std::pair<const char*, bool> kHistogramNames[] = {

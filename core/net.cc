@@ -5,6 +5,9 @@
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <poll.h>
+#ifdef __linux__
+#include <sys/epoll.h>
+#endif
 #include <sys/socket.h>
 #include <unistd.h>
 
@@ -12,6 +15,7 @@
 #include <cerrno>
 #include <cmath>
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <type_traits>
 
@@ -20,6 +24,16 @@
 #include "verify_pool.h"
 
 namespace pbft {
+
+void tune_stream_socket(int fd) {
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+}
+
+void tune_listen_socket(int fd) {
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+}
 
 namespace {
 
@@ -50,6 +64,7 @@ int dial_socket(const std::string& host_port, bool nonblocking,
   if (!split_host_port(host_port, &host, &port)) return -1;
   int fd = socket(AF_INET, SOCK_STREAM, 0);
   if (fd < 0) return -1;
+  tune_stream_socket(fd);
   if (nonblocking) set_nonblocking(fd);
   sockaddr_in addr{};
   addr.sin_family = AF_INET;
@@ -65,11 +80,154 @@ int dial_socket(const std::string& host_port, bool nonblocking,
     }
     if (in_progress) *in_progress = true;
   }
-  int one = 1;
-  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
   return fd;
 }
 }  // namespace
+
+// -- readiness backends (ISSUE 10 tentpole) ---------------------------------
+
+namespace {
+
+// Portable fallback (and the PBFT_NET_POLL=1 parity lever): a persistent
+// pollfd table maintained incrementally — add appends, remove
+// swap-erases, write interest flips one events field. O(1) each via the
+// fd index map; never rebuilt per iteration.
+class PollPoller : public Poller {
+ public:
+  const char* name() const override { return "poll"; }
+
+  bool add(int fd, uint64_t tag, bool /*edge*/) override {
+    index_[fd] = pfds_.size();
+    pfds_.push_back({fd, POLLIN, 0});
+    tags_.push_back(tag);
+    return true;
+  }
+
+  void remove(int fd) override {
+    auto it = index_.find(fd);
+    if (it == index_.end()) return;
+    size_t i = it->second;
+    index_.erase(it);
+    size_t last = pfds_.size() - 1;
+    if (i != last) {
+      pfds_[i] = pfds_[last];
+      tags_[i] = tags_[last];
+      index_[pfds_[i].fd] = i;
+    }
+    pfds_.pop_back();
+    tags_.pop_back();
+  }
+
+  void set_write_interest(int fd, bool want) override {
+    auto it = index_.find(fd);
+    if (it == index_.end()) return;
+    pfds_[it->second].events = (short)(POLLIN | (want ? POLLOUT : 0));
+  }
+
+  int wait(std::vector<PollerEvent>* out, int timeout_ms) override {
+    int n = ::poll(pfds_.data(), (nfds_t)pfds_.size(), timeout_ms);
+    if (n <= 0) return n;
+    for (size_t i = 0; i < pfds_.size(); ++i) {
+      short re = pfds_[i].revents;
+      if (!re) continue;
+      out->push_back({tags_[i], (re & (POLLIN | POLLHUP | POLLERR)) != 0,
+                      (re & POLLOUT) != 0,
+                      (re & (POLLERR | POLLHUP | POLLNVAL)) != 0});
+    }
+    return n;
+  }
+
+ private:
+  std::vector<pollfd> pfds_;
+  std::vector<uint64_t> tags_;
+  std::map<int, size_t> index_;
+};
+
+#ifdef __linux__
+// Edge-triggered epoll: connections register EPOLLIN|EPOLLOUT|EPOLLET
+// ONCE and are never re-armed — reads drain to EAGAIN, writes flush
+// eagerly at enqueue, and an EPOLLOUT edge resumes a partially-written
+// queue when the kernel buffer empties. Sentinel fds (listener, metrics,
+// verifier stream) stay level-triggered: their handlers do bounded work
+// per event and partial reads must re-fire.
+class EpollPoller : public Poller {
+ public:
+  EpollPoller() : epfd_(epoll_create1(EPOLL_CLOEXEC)) {}
+  ~EpollPoller() override {
+    if (epfd_ >= 0) close(epfd_);
+  }
+  bool ok() const { return epfd_ >= 0; }
+  const char* name() const override { return "epoll-et"; }
+
+  bool add(int fd, uint64_t tag, bool edge) override {
+    epoll_event ev{};
+    ev.events = edge ? (EPOLLIN | EPOLLOUT | EPOLLET) : EPOLLIN;
+    ev.data.u64 = tag;
+    return epoll_ctl(epfd_, EPOLL_CTL_ADD, fd, &ev) == 0;
+  }
+
+  void remove(int fd) override {
+    // EBADF/ENOENT are expected when the fd already closed (the kernel
+    // auto-deregisters closed fds) — removal is best-effort by design.
+    epoll_event ev{};
+    (void)epoll_ctl(epfd_, EPOLL_CTL_DEL, fd, &ev);
+  }
+
+  void set_write_interest(int /*fd*/, bool /*want*/) override {}
+
+  int wait(std::vector<PollerEvent>* out, int timeout_ms) override {
+    epoll_event evs[256];
+    int n = epoll_wait(epfd_, evs, 256, timeout_ms);
+    for (int i = 0; i < n; ++i) {
+      uint32_t e = evs[i].events;
+      out->push_back({evs[i].data.u64,
+                      (e & (EPOLLIN | EPOLLHUP | EPOLLERR)) != 0,
+                      (e & EPOLLOUT) != 0, (e & (EPOLLERR | EPOLLHUP)) != 0});
+    }
+    return n;
+  }
+
+ private:
+  int epfd_;
+};
+#endif  // __linux__
+
+}  // namespace
+
+std::unique_ptr<Poller> make_poller() {
+#ifdef __linux__
+  const char* force = std::getenv("PBFT_NET_POLL");
+  if (force == nullptr || *force == '\0' || *force == '0') {
+    auto ep = std::make_unique<EpollPoller>();
+    if (ep->ok()) return ep;
+  }
+#endif
+  return std::make_unique<PollPoller>();
+}
+
+namespace {
+// Poller sentinel tags for non-Conn fds (heap pointers are aligned and
+// never collide with these small values).
+constexpr uint64_t kTagListener = 1;
+constexpr uint64_t kTagMetrics = 2;
+constexpr uint64_t kTagVerifier = 3;
+
+// Bounded outbound queue per connection (ISSUE 10 satellite): past this,
+// frames are dropped and counted instead of growing without limit
+// against a slow or black-holed reader. 8 MiB ≈ thousands of protocol
+// frames — far beyond what retransmission-covered loss can justify
+// buffering.
+constexpr size_t kMaxConnOutbound = 8u << 20;
+// Coalescing target for send blocks: frames pack into pooled blocks of
+// about this size so one send() carries many frames.
+constexpr size_t kMaxSendBlock = 64u << 10;
+// Gateway route-cache bound: on overflow the cache CLEARS and un-routed
+// "gw/" replies fan out over all gateway links until re-registration —
+// extra frames, never lost quorums.
+constexpr size_t kMaxGatewayRoutes = 1u << 17;
+}  // namespace
+
+const char* ReplicaServer::net_backend() const { return poller_->name(); }
 
 bool fault_mode_from_string(const std::string& s, FaultMode* out) {
   if (s.empty() || s == "none") *out = FaultMode::kNone;
@@ -94,6 +252,9 @@ ReplicaServer::ReplicaServer(ClusterConfig cfg, int64_t id,
                              std::unique_ptr<Verifier> verifier)
     : cfg_(cfg), id_(id), verifier_(std::move(verifier)) {
   std::memcpy(seed_, seed, 32);
+  // Readiness backend before any conn can exist: every accept/dial path
+  // registers with the poller unconditionally.
+  poller_ = make_poller();
   replica_ = std::make_unique<Replica>(cfg_, id_, seed);
   // Consensus-phase spans: the hook costs one branch inside on_phase when
   // neither metrics nor tracing is active (the Tracer discipline).
@@ -123,8 +284,7 @@ ReplicaServer::~ReplicaServer() {
 bool ReplicaServer::start() {
   listen_fd_ = socket(AF_INET, SOCK_STREAM, 0);
   if (listen_fd_ < 0) return false;
-  int one = 1;
-  setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  tune_listen_socket(listen_fd_);
   sockaddr_in addr{};
   addr.sin_family = AF_INET;
   addr.sin_addr.s_addr = htonl(INADDR_ANY);
@@ -135,15 +295,14 @@ bool ReplicaServer::start() {
   getsockname(listen_fd_, (sockaddr*)&addr, &len);
   listen_port_ = ntohs(addr.sin_port);
   set_nonblocking(listen_fd_);
+  poller_->add(listen_fd_, kTagListener, /*edge=*/false);
   if (metrics_port_ >= 0) {
     metrics_listen_fd_ = socket(AF_INET, SOCK_STREAM, 0);
     sockaddr_in maddr{};
     maddr.sin_family = AF_INET;
     maddr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
     maddr.sin_port = htons((uint16_t)metrics_port_);
-    int mone = 1;
-    setsockopt(metrics_listen_fd_, SOL_SOCKET, SO_REUSEADDR, &mone,
-               sizeof(mone));
+    if (metrics_listen_fd_ >= 0) tune_listen_socket(metrics_listen_fd_);
     if (metrics_listen_fd_ < 0 ||
         bind(metrics_listen_fd_, (sockaddr*)&maddr, sizeof(maddr)) != 0 ||
         listen(metrics_listen_fd_, 16) != 0) {
@@ -156,6 +315,7 @@ bool ReplicaServer::start() {
       getsockname(metrics_listen_fd_, (sockaddr*)&maddr, &mlen);
       metrics_listen_port_ = ntohs(maddr.sin_port);
       set_nonblocking(metrics_listen_fd_);
+      poller_->add(metrics_listen_fd_, kTagMetrics, /*edge=*/false);
       metrics_.enabled = true;
     }
   }
@@ -214,44 +374,6 @@ void ReplicaServer::poll_once(int timeout_ms) {
           std::min<int64_t>(timeout_ms, std::max<int64_t>(rem, 0) + 1);
     }
   }
-  std::vector<pollfd> pfds;
-  pfds.push_back({listen_fd_, POLLIN, 0});
-  std::vector<Conn*> order;
-  auto now = std::chrono::steady_clock::now();
-  auto add_conn = [&](Conn* c) {
-    if (c->closed) return;
-    if (c->connecting) {
-      // Reap dials that never complete (black-holed address): the
-      // deadline bounds how long a one-shot reply or peer link can sit.
-      if (now > c->connect_deadline) {
-        mark_closed(*c);
-        return;
-      }
-      pfds.push_back({c->fd, POLLOUT, 0});  // connect completion only
-    } else {
-      short ev = POLLIN;
-      if (!c->wbuf.empty()) ev |= POLLOUT;
-      pfds.push_back({c->fd, ev, 0});
-    }
-    order.push_back(c);
-  };
-  for (auto& c : conns_) add_conn(c.get());
-  // Outbound links are read-polled too: handshake replies and reject
-  // frames arrive on the dialed connection.
-  for (auto& [_, c] : peers_) add_conn(c.get());
-  // Async verifier launch in flight: poll its socket alongside the
-  // peers — verdict readiness is just another I/O event.
-  const size_t conn_pfds_end = pfds.size();
-  size_t verifier_pfd = 0;  // 0 = not polled (slot 0 is the listener)
-  if (verify_inflight_ && verifier_->async_fd() >= 0) {
-    verifier_pfd = pfds.size();
-    pfds.push_back({verifier_->async_fd(), POLLIN, 0});
-  }
-  size_t metrics_pfd = 0;
-  if (metrics_listen_fd_ >= 0) {
-    metrics_pfd = pfds.size();
-    pfds.push_back({metrics_listen_fd_, POLLIN, 0});
-  }
   if (verify_inflight_ && verify_deadline_ms_ > 0) {
     // Don't let a quiet cluster sleep past the wedge deadline.
     auto rem = std::chrono::duration_cast<std::chrono::milliseconds>(
@@ -261,25 +383,46 @@ void ReplicaServer::poll_once(int timeout_ms) {
                    .count();
     timeout_ms = std::min<int64_t>(timeout_ms, std::max<int64_t>(rem, 0) + 1);
   }
-  int n = ::poll(pfds.data(), pfds.size(), timeout_ms);
+  if (connecting_count_ > 0) {
+    // Nonblocking dials in flight: wake often enough that the sweep
+    // reaps an overdue connect within ~100 ms of its deadline.
+    timeout_ms = std::min(timeout_ms, 100);
+  }
+  // Persistent registrations: conns/listeners/the verifier stream were
+  // registered at creation — the wait is one syscall over the backend's
+  // standing table, no per-iteration pollfd rebuild.
+  events_.clear();
+  int n = poller_->wait(&events_, timeout_ms);
   if (n < 0) return;
-  if (pfds[0].revents & POLLIN) accept_ready();
-  for (size_t i = 1; i < conn_pfds_end; ++i) {
-    Conn* c = order[i - 1];
-    if (c->closed) continue;
-    if (c->connecting) {
-      if (pfds[i].revents & (POLLOUT | POLLERR | POLLHUP)) finish_connect(*c);
+  ++event_wakeups_;
+  metrics_.inc("pbft_epoll_wakeups_total");
+  for (const PollerEvent& ev : events_) {
+    if (ev.tag == kTagListener) {
+      if (ev.readable) accept_ready();
       continue;
     }
-    if (pfds[i].revents & (POLLIN | POLLHUP | POLLERR)) handle_readable(*c);
-    if ((pfds[i].revents & POLLOUT) && !c->closed) flush(*c);
-  }
-  if (verifier_pfd != 0 &&
-      (pfds[verifier_pfd].revents & (POLLIN | POLLHUP | POLLERR))) {
-    finish_verify_async();
-  }
-  if (metrics_pfd != 0 && (pfds[metrics_pfd].revents & POLLIN)) {
-    serve_metrics_ready();
+    if (ev.tag == kTagMetrics) {
+      if (ev.readable) serve_metrics_ready();
+      continue;
+    }
+    if (ev.tag == kTagVerifier) {
+      // Async verifier verdict readiness is just another I/O event.
+      if (verify_inflight_ && (ev.readable || ev.error)) {
+        finish_verify_async();
+      }
+      continue;
+    }
+    Conn* c = reinterpret_cast<Conn*>((uintptr_t)ev.tag);
+    // A conn closed earlier THIS iteration still owns its (stale) event:
+    // the object lives until the end-of-pass sweep, so the flag check is
+    // safe — and fd reuse cannot alias it, closed fds left the poller.
+    if (c->closed) continue;
+    if (c->connecting) {
+      if (ev.writable || ev.error) finish_connect(*c);
+      continue;
+    }
+    if (ev.readable || ev.error) handle_readable(*c);
+    if (ev.writable && !c->closed) flush(*c);
   }
   check_verify_deadline(std::chrono::steady_clock::now());
   // Seal a partial request batch once it has waited its flush window
@@ -302,7 +445,29 @@ void ReplicaServer::poll_once(int timeout_ms) {
       last_beacon_ = now;
     }
   }
-  // Drop closed inbound connections.
+  sweep_conns();
+}
+
+// Reap overdue nonblocking connects, drop closed conns (their pooled
+// buffers return to the pool), refresh the connecting count and the
+// connections-open gauge. Runs once per iteration AFTER event dispatch —
+// a Conn closed mid-pass must outlive any stale event referencing it.
+void ReplicaServer::sweep_conns() {
+  const auto now = std::chrono::steady_clock::now();
+  connecting_count_ = 0;
+  auto visit = [&](Conn& c) {
+    if (!c.closed && c.connecting) {
+      // Reap dials that never complete (black-holed address): the
+      // deadline bounds how long a one-shot reply or peer link can sit.
+      if (now > c.connect_deadline) {
+        mark_closed(c);
+      } else {
+        ++connecting_count_;
+      }
+    }
+  };
+  for (auto& c : conns_) visit(*c);
+  for (auto& [_, c] : peers_) visit(*c);
   conns_.erase(
       std::remove_if(conns_.begin(), conns_.end(),
                      [](const std::unique_ptr<Conn>& c) { return c->closed; }),
@@ -314,6 +479,34 @@ void ReplicaServer::poll_once(int timeout_ms) {
       ++it;
     }
   }
+  metrics_.set_gauge("pbft_connections_open",
+                     (double)(conns_.size() + peers_.size()));
+}
+
+void ReplicaServer::register_conn(Conn& c) {
+  poller_->add(c.fd, (uint64_t)(uintptr_t)&c, /*edge=*/true);
+  if (c.connecting || !c.out.empty()) {
+    // Fallback backend: arm POLLOUT for connect completion / queued
+    // bytes (no-op under epoll — EPOLLOUT is edge-armed at add).
+    poller_->set_write_interest(c.fd, true);
+  }
+}
+
+// The async verifier's fd lives only while a launch is in flight, so it
+// registers per launch and deregisters at completion/wedge — LEVEL
+// triggered: poll_result reads partially and must re-fire while verdict
+// bytes remain buffered.
+void ReplicaServer::register_verifier_fd() {
+  int fd = verifier_->async_fd();
+  if (fd < 0 || fd == verifier_fd_) return;
+  poller_->add(fd, kTagVerifier, /*edge=*/false);
+  verifier_fd_ = fd;
+}
+
+void ReplicaServer::unregister_verifier_fd() {
+  if (verifier_fd_ < 0) return;
+  poller_->remove(verifier_fd_);
+  verifier_fd_ = -1;
 }
 
 void ReplicaServer::accept_ready() {
@@ -321,15 +514,18 @@ void ReplicaServer::accept_ready() {
     int fd = accept(listen_fd_, nullptr, nullptr);
     if (fd < 0) return;
     set_nonblocking(fd);
-    int one = 1;
-    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    tune_stream_socket(fd);
     auto c = std::make_unique<Conn>();
     c->fd = fd;
+    c->rbuf.data = pool_.acquire();
+    register_conn(*c);
     conns_.push_back(std::move(c));
   }
 }
 
 void ReplicaServer::handle_readable(Conn& c) {
+  // Drains to EAGAIN — REQUIRED under the edge-triggered backend: a
+  // partial drain would leave buffered bytes with no further edge.
   char buf[65536];
   for (;;) {
     ssize_t r = read(c.fd, buf, sizeof(buf));
@@ -354,32 +550,32 @@ void ReplicaServer::process_buffer(Conn& c) {
     // unauthenticated request-injection channel. In the common path the
     // conn closes at flush before reading anything; this guard covers the
     // partial-flush window where the conn stays open and readable.
-    c.rbuf.clear();
+    c.rbuf.reset();
     return;
   }
   if (!c.sniffed && !c.rbuf.empty()) {
     c.sniffed = true;
     // The client gateway keeps the reference's telnet-able contract: raw
     // JSON (no length prefix), one message per line/connection.
-    c.raw_json = c.rbuf[0] == '{';
+    c.raw_json = c.rbuf.at(0) == '{';
   }
   if (c.raw_json) {
     for (;;) {
       auto nl = c.rbuf.find('\n');
       std::string payload;
       if (nl != std::string::npos) {
-        payload = c.rbuf.substr(0, nl);
-        c.rbuf.erase(0, nl + 1);
+        payload = c.rbuf.take(nl);
+        c.rbuf.consume(1);
       } else if (c.closed || c.fd < 0) {
-        payload.swap(c.rbuf);
+        payload = c.rbuf.take(c.rbuf.size());
       } else {
         // Wait for more bytes — but try a complete object eagerly so a
         // no-newline sender (telnet paste) still goes through. Bounded:
         // a line larger than 1 MiB on this unauthenticated socket is a
         // protocol violation and drops the connection (the framed path
         // caps at 2^24 below; the raw path must not buffer without bound).
-        if (Json::parse(c.rbuf)) {
-          payload.swap(c.rbuf);
+        if (Json::parse(c.rbuf.str())) {
+          payload = c.rbuf.take(c.rbuf.size());
         } else if (c.rbuf.size() > (1u << 20)) {
           mark_closed(c);
           return;
@@ -409,15 +605,16 @@ void ReplicaServer::process_buffer(Conn& c) {
   // Framed replica-to-replica stream.
   for (;;) {
     if (c.rbuf.size() < 4) return;
-    uint32_t len = ((uint8_t)c.rbuf[0] << 24) | ((uint8_t)c.rbuf[1] << 16) |
-                   ((uint8_t)c.rbuf[2] << 8) | (uint8_t)c.rbuf[3];
+    uint32_t len = ((uint32_t)c.rbuf.at(0) << 24) |
+                   ((uint32_t)c.rbuf.at(1) << 16) |
+                   ((uint32_t)c.rbuf.at(2) << 8) | (uint32_t)c.rbuf.at(3);
     if (len > (1u << 24)) {  // corrupt frame; drop the connection
       mark_closed(c);
       return;
     }
     if (c.rbuf.size() < 4 + (size_t)len) return;
-    std::string payload = c.rbuf.substr(4, len);
-    c.rbuf.erase(0, 4 + (size_t)len);
+    c.rbuf.consume(4);
+    std::string payload = c.rbuf.take(len);
     if (!handle_peer_frame(c, std::move(payload))) return;
   }
 }
@@ -436,10 +633,39 @@ std::string frame_payload(const std::string& payload) {
 }
 }  // namespace
 
+void ReplicaServer::count_backpressure() {
+  ++backpressure_events_;
+  metrics_.inc("pbft_write_backpressure_events_total");
+}
+
+bool ReplicaServer::outbound_has_room(Conn& c) {
+  if (c.out.bytes <= kMaxConnOutbound) return true;
+  // Drop-and-count (ISSUE 10 satellite): a slow or black-holed reader
+  // must not grow this queue without limit — PBFT retransmission absorbs
+  // the dropped frame exactly like a chaos link drop.
+  count_backpressure();
+  return false;
+}
+
+void ReplicaServer::queue_bytes(Conn& c, const std::string& framed) {
+  auto& q = c.out;
+  // Coalesce into pooled blocks so one send() carries many frames; the
+  // back block may be the partially-sent front — appending to it is fine
+  // (flush addresses data()+front_pos each call).
+  if (!q.blocks.empty() && q.blocks.back().size() + framed.size() <= kMaxSendBlock) {
+    q.blocks.back() += framed;
+  } else {
+    std::string b = pool_.acquire();
+    b += framed;
+    q.blocks.push_back(std::move(b));
+  }
+  q.bytes += framed.size();
+}
+
 bool ReplicaServer::reject_conn(Conn& c, const std::string& reason) {
   std::fprintf(stderr, "replica %lld: rejecting peer link: %s\n",
                (long long)id_, reason.c_str());
-  c.wbuf += frame_payload(SecureChannel::reject_payload(reason));
+  queue_bytes(c, frame_payload(SecureChannel::reject_payload(reason)));
   flush(c);  // best-effort: the reject may be truncated if the link stalls
   if (!c.closed) {
     mark_closed(c);
@@ -469,9 +695,9 @@ bool ReplicaServer::handle_peer_frame(Conn& c, std::string payload) {
       // already JSON-encoded; mixed frames on one link are fine — the
       // receiver detects the codec per frame).
       c.codec_binary = hello_offers_binary(*j);
-      c.wbuf += frame_payload(*auth);
+      queue_bytes(c, frame_payload(*auth));
       for (auto& p : c.pending)
-        c.wbuf += frame_payload(c.chan->seal_frame(p));
+        queue_bytes(c, frame_payload(c.chan->seal_frame(p)));
       c.pending.clear();
       flush(c);
       return !c.closed;
@@ -502,18 +728,34 @@ bool ReplicaServer::handle_peer_frame(Conn& c, std::string payload) {
       std::string err;
       if (!SecureChannel::check_version(*j, &err)) return reject_conn(c, err);
       c.hello_seen = true;
+      // Gateway trust (ISSUE 10): a hello carrying role=gateway marks
+      // this link as a client-gateway — framed client requests arrive on
+      // it, and replies for those clients fan BACK over it instead of
+      // per-reply dial-backs. Gateways hold no replica identity, so the
+      // signed-DH handshake cannot admit them: plaintext clusters only.
+      const Json* role = j->find("role");
+      if (role && role->is_string() && role->as_string() == "gateway") {
+        if (cfg_.secure) {
+          return reject_conn(
+              c, "gateway links require a plaintext cluster (a gateway "
+                 "has no replica identity to authenticate)");
+        }
+        c.gateway = true;
+        c.link_id = ++gateway_link_seq_;
+        gateway_links_[c.link_id] = &c;
+      }
       if (cfg_.secure) {
         c.chan = std::make_unique<SecureChannel>(&cfg_, id_, seed_,
                                                  /*initiator=*/false);
         auto reply = c.chan->on_hello(*j);
         if (!reply) return reject_conn(c, c.chan->error());
-        c.wbuf += frame_payload(*reply);
+        queue_bytes(c, frame_payload(*reply));
         flush(c);
       } else {
         // Plaintext hello-ack: advertise this node's version + codec
         // offer so the dialing peer can negotiate binary-v2 (a 1.0.0
         // initiator parses and ignores any non-reject frame).
-        c.wbuf += frame_payload(SecureChannel::plain_hello(id_));
+        queue_bytes(c, frame_payload(SecureChannel::plain_hello(id_)));
         flush(c);
       }
       return !c.closed;
@@ -541,7 +783,16 @@ bool ReplicaServer::handle_peer_frame(Conn& c, std::string payload) {
     ++frames_in_;
     metrics_.inc("pbft_frames_in_total");
     if (std::holds_alternative<ClientRequest>(*msg)) {
-      trace_request_rx(std::get<ClientRequest>(*msg));
+      const auto& req = std::get<ClientRequest>(*msg);
+      if (c.gateway) {
+        // Remember the forwarding link so this client's reply can fan
+        // back over it (exact route; the "gw/" prefix fallback covers
+        // replicas that only saw the request via pre-prepare).
+        note_gateway_route(req.client, c.link_id);
+        ++gateway_forwarded_;
+        metrics_.inc("pbft_gateway_forwarded_total");
+      }
+      trace_request_rx(req);
       emit(replica_->receive(*msg));
     } else {
       // Receive-side canonical reuse: derive the signable digest from
@@ -557,8 +808,21 @@ bool ReplicaServer::handle_peer_frame(Conn& c, std::string payload) {
 
 void ReplicaServer::mark_closed(Conn& c) {
   if (c.closed) return;
-  if (c.fd >= 0) close(c.fd);
+  if (c.fd >= 0) {
+    // Deregister BEFORE close: the fallback backend keeps polling a
+    // removed fd otherwise (POLLNVAL forever); epoll auto-deregisters on
+    // close, so the explicit remove is merely redundant there.
+    poller_->remove(c.fd);
+    close(c.fd);
+  }
   c.closed = true;
+  // Return pooled storage: the recv buffer and every queued send block
+  // go back to the free list for the next accept/dial.
+  pool_.release(std::move(c.rbuf.data));
+  c.rbuf = RecvBuf{};
+  for (auto& b : c.out.blocks) pool_.release(std::move(b));
+  c.out = SendQueue{};
+  if (c.gateway) gateway_links_.erase(c.link_id);
   if (c.close_when_flushed) {
     if (reply_dials_in_flight_ > 0) --reply_dials_in_flight_;
     if (!c.reply_addr.empty()) reply_addrs_in_flight_.erase(c.reply_addr);
@@ -578,16 +842,40 @@ void ReplicaServer::finish_connect(Conn& c) {
 
 void ReplicaServer::flush(Conn& c) {
   if (c.connecting) return;  // nothing sendable until the connect lands
-  while (!c.wbuf.empty()) {
-    ssize_t w = send(c.fd, c.wbuf.data(), c.wbuf.size(), MSG_NOSIGNAL);
-    if (w > 0) {
-      c.wbuf.erase(0, (size_t)w);
+  SendQueue& q = c.out;
+  while (!q.blocks.empty()) {
+    std::string& b = q.blocks.front();
+    size_t avail = b.size() - q.front_pos;
+    if (avail == 0) {  // fully-sent block: recycle and advance
+      pool_.release(std::move(b));
+      q.blocks.pop_front();
+      q.front_pos = 0;
       continue;
     }
-    if (w < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) return;
+    ssize_t w = send(c.fd, b.data() + q.front_pos, avail, MSG_NOSIGNAL);
+    if (w > 0) {
+      q.front_pos += (size_t)w;
+      q.bytes -= (size_t)w;
+      continue;
+    }
+    if (w < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+      // Partial-write backpressure: the kernel buffer is full. Resume on
+      // write readiness — an EPOLLOUT edge on the ET backend (armed once
+      // at registration), explicit POLLOUT interest on the fallback. One
+      // backpressure count per backed-up episode (the latch).
+      poller_->set_write_interest(c.fd, true);
+      if (!c.backpressured) {
+        c.backpressured = true;
+        count_backpressure();
+      }
+      return;
+    }
     mark_closed(c);
     return;
   }
+  q.front_pos = 0;
+  c.backpressured = false;
+  poller_->set_write_interest(c.fd, false);
   if (c.close_when_flushed) {  // one-shot dial-back reply delivered
     mark_closed(c);
   }
@@ -819,6 +1107,7 @@ void ReplicaServer::serve_metrics_ready() {
   for (;;) {
     int fd = accept(metrics_listen_fd_, nullptr, nullptr);
     if (fd < 0) return;
+    tune_stream_socket(fd);
     // One-shot scrape: the request bytes are irrelevant (any GET gets the
     // full exposition), so drain best-effort, answer, close. The body is
     // a few KB — one blocking send fits the socket buffer.
@@ -854,6 +1143,7 @@ void ReplicaServer::check_verify_deadline(
   // forever. Drop the transport and run the CPU safety net on the batch —
   // same degradation contract as a detected transport failure. Any late
   // reply lands on a closed socket; it cannot double-deliver.
+  unregister_verifier_fd();  // before cancel closes the fd
   verifier_->cancel_inflight();
   ++verify_deadline_fired_;
   metrics_.inc("pbft_verify_deadline_fired_total");
@@ -950,6 +1240,7 @@ void ReplicaServer::run_verify_batch() {
     verify_inflight_ = true;
     inflight_items_ = std::move(items);
     inflight_start_ = std::chrono::steady_clock::now();
+    register_verifier_fd();
     return;
   }
   auto t0 = std::chrono::steady_clock::now();
@@ -1002,6 +1293,7 @@ void ReplicaServer::finish_verify_async() {
   std::vector<uint8_t> verdicts;
   bool failed = false;
   if (!verifier_->poll_result(&verdicts, &failed)) return;  // partial read
+  unregister_verifier_fd();
   if (failed) {
     // Service died mid-launch: a verifier outage degrades throughput,
     // never safety/liveness — re-verify this batch in-process.
@@ -1287,13 +1579,15 @@ int ReplicaServer::peer_fd(int64_t dest) {
   // Link prologue: every peer link opens with a version-carrying hello;
   // secure clusters start the full handshake (protocol messages queue in
   // c->pending until it completes).
+  c->rbuf.data = pool_.acquire();
   if (cfg_.secure) {
     c->chan = std::make_unique<SecureChannel>(&cfg_, id_, seed_,
                                               /*initiator=*/true, dest);
-    c->wbuf += frame_payload(c->chan->initiator_hello());
+    queue_bytes(*c, frame_payload(c->chan->initiator_hello()));
   } else {
-    c->wbuf += frame_payload(SecureChannel::plain_hello(id_));
+    queue_bytes(*c, frame_payload(SecureChannel::plain_hello(id_)));
   }
+  register_conn(*c);
   peers_[dest] = std::move(c);
   return fd;
 }
@@ -1345,15 +1639,20 @@ void ReplicaServer::send_encoded(int64_t dest, EncodedOut& enc) {
       flush(c);
       return;
     }
+    // Bounded-outbound admission BEFORE the seal: sealing consumes the
+    // link's AEAD nonce, so a post-seal drop would desync the channel —
+    // the admission drop must look like the frame was never sealed.
+    if (!outbound_has_room(c)) return;  // drop-and-count, like a link drop
     // Per-peer sealing over the SHARED plaintext: the AEAD counter is
     // per-link state, so only the seal (not the encode) runs per peer.
     std::string framed = frame_payload(c.chan->seal_frame(*payload));
     if (!chaos_pass(dest, framed)) return;
-    c.wbuf += framed;
+    queue_bytes(c, framed);
   } else {
     std::string framed = frame_payload(*payload);
     if (!chaos_pass(dest, framed)) return;
-    c.wbuf += framed;
+    if (!outbound_has_room(c)) return;
+    queue_bytes(c, framed);
   }
   flush(c);
 }
@@ -1381,7 +1680,10 @@ void ReplicaServer::pump_chaos_queue(
       auto p = peers_.find(it->first);
       if (p != peers_.end() && !p->second->closed &&
           !p->second->connecting) {
-        p->second->wbuf += q.front().second;
+        // Unconditional enqueue: these frames passed admission (and were
+        // sealed) at send time — a bounded-outbound drop HERE would
+        // desync a secure link's AEAD nonce sequence.
+        queue_bytes(*p->second, q.front().second);
         flush(*p->second);
       } else {
         // Link died while the frame was held: the delay became a drop.
@@ -1392,6 +1694,25 @@ void ReplicaServer::pump_chaos_queue(
     }
     it = q.empty() ? chaos_queue_.erase(it) : std::next(it);
   }
+}
+
+// Remember which gateway link forwarded for `client`: the exact-route
+// half of the reply fan-back. Bounded — on overflow the cache clears and
+// un-routed "gw/" replies fall back to a fan-out over all gateway links
+// (extra frames, never lost quorums).
+void ReplicaServer::note_gateway_route(const std::string& client,
+                                       uint64_t link_id) {
+  if (gateway_routes_.size() >= kMaxGatewayRoutes) gateway_routes_.clear();
+  gateway_routes_[client] = link_id;
+}
+
+// Route a reply back over a gateway link: one framed raw-JSON payload on
+// the SAME persistent connection the request came in on — the whole
+// point of the tier (no per-reply dial-back, no per-client socket).
+void ReplicaServer::send_gateway_reply(Conn& g, const std::string& payload) {
+  if (g.closed || !outbound_has_room(g)) return;  // drop-and-count
+  queue_bytes(g, frame_payload(payload));
+  flush(g);
 }
 
 void ReplicaServer::dial_reply(const std::string& client_addr,
@@ -1409,6 +1730,30 @@ void ReplicaServer::dial_reply(const std::string& client_addr,
   if (fault_mode_ == FaultMode::kSigCorrupt && !out.sig.empty()) {
     out.sig.assign(out.sig.size(), 'f');
     count_fault();
+  }
+  if (client_addr.compare(0, 3, kGatewayClientPrefix) == 0) {
+    // Gateway-routed client (ISSUE 10): the "address" is a routing
+    // token, never dialable. Exact route when this replica saw the
+    // request arrive on a gateway link; otherwise fan out over every
+    // gateway link (gateways drop tokens they don't own) — a backup
+    // that only saw the request via pre-prepare still reaches the
+    // client's gateway for the f+1 reply quorum.
+    std::string payload = out.to_json().dump();
+    auto rt = gateway_routes_.find(client_addr);
+    if (rt != gateway_routes_.end()) {
+      auto g = gateway_links_.find(rt->second);
+      if (g != gateway_links_.end()) {
+        send_gateway_reply(*g->second, payload);
+        return;
+      }
+      gateway_routes_.erase(rt);  // link died: fall through to fan-out
+    }
+    if (gateway_links_.empty()) {
+      ++replies_dropped_;  // retransmission re-fetches the cached reply
+      return;
+    }
+    for (auto& [_, g] : gateway_links_) send_gateway_reply(*g, payload);
+    return;
   }
   start_reply_dial(client_addr, out.to_json().dump() + "\n");
 }
@@ -1442,9 +1787,11 @@ void ReplicaServer::reply_dial_now(const std::string& addr,
       std::chrono::steady_clock::now() + std::chrono::seconds(3);
   c->close_when_flushed = true;
   c->reply_addr = addr;
-  c->wbuf = std::move(payload);
+  c->rbuf.data = pool_.acquire();
+  queue_bytes(*c, payload);
   ++reply_dials_in_flight_;  // mark_closed decrements on every close path
   reply_addrs_in_flight_.insert(addr);
+  register_conn(*c);
   flush(*c);
   if (!c->closed) conns_.push_back(std::move(c));
 }
@@ -1501,7 +1848,13 @@ std::string ReplicaServer::metrics_json() const {
   JsonObject o;
   o["replica"] = Json(id_);
   o["port"] = Json(listen_port_);
+  o["net_backend"] = Json(std::string(poller_->name()));
   o["frames_in"] = Json(frames_in_);
+  o["connections_open"] = Json((int64_t)(conns_.size() + peers_.size()));
+  o["event_wakeups"] = Json(event_wakeups_);
+  o["backpressure_events"] = Json(backpressure_events_);
+  o["gateway_links"] = Json((int64_t)gateway_links_.size());
+  o["gateway_forwarded"] = Json(gateway_forwarded_);
   o["verify_batches"] = Json(batches_run_);
   o["broadcasts"] = Json(broadcasts_);
   o["broadcast_encodes"] = Json(broadcast_encodes_);

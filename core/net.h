@@ -8,11 +8,21 @@
 // contract: JSON request in over TCP, reply *dialed back* to the client's
 // advertised address (reference src/client_handler.rs:75-84, README.md:33-43).
 //
-// Single-threaded poll() event loop; the consensus core stays I/O-free and
+// Single-threaded event loop; the consensus core stays I/O-free and
 // deterministic. Each loop iteration drains every readable socket into the
 // replica's inbox, then runs ONE verifier batch over everything that
 // arrived — the batching window that feeds the TPU verifier (BASELINE.json
 // north_star) emerges naturally from socket-level concurrency.
+//
+// ISSUE 10 (scale-out): readiness comes from a persistent-registration
+// Poller — edge-triggered epoll on Linux (fds registered once at
+// accept/dial, deregistered at close), with a level-triggered poll()
+// fallback for non-epoll hosts (PBFT_NET_POLL=1 forces it, which is the
+// parity-test lever). Connections carry reusable pooled read buffers and
+// a bounded outbound block queue with partial-write backpressure, and a
+// client-gateway tier (pbft_tpu/net/gateway.py) multiplexes thousands of
+// client identities onto a few persistent framed links whose replies fan
+// back over the SAME link instead of per-reply dial-backs.
 #pragma once
 
 #include <array>
@@ -37,11 +47,144 @@
 
 namespace pbft {
 
+// Stream-socket option discipline (ISSUE 10 satellite): EVERY data socket
+// gets TCP_NODELAY (consensus frames are latency-critical and small; one
+// Nagle stall per hop dwarfs a round), every listener SO_REUSEADDR.
+// scripts/pbft_lint.py (analysis/sockets.py) statically requires each
+// socket()/accept() site in core/ to call one of these.
+void tune_stream_socket(int fd);
+void tune_listen_socket(int fd);
+
+// Gateway-routed client identities carry this prefix (mirrored by
+// pbft_tpu/net/gateway.py GATEWAY_CLIENT_PREFIX; constants lint): such a
+// "client address" is a routing token, never a dialable host:port — a
+// reply that cannot be routed over a gateway link is dropped for the
+// retransmission path, not dialed.
+inline constexpr const char* kGatewayClientPrefix = "gw/";
+
+// Reusable receive buffer: consumption advances an offset instead of
+// erase(0, n)'s per-frame memmove; the storage compacts lazily and resets
+// (capacity retained) when drained. Backing strings come from the
+// server's BufferPool so connection churn doesn't malloc per accept.
+struct RecvBuf {
+  std::string data;
+  size_t pos = 0;
+
+  size_t size() const { return data.size() - pos; }
+  bool empty() const { return pos == data.size(); }
+  uint8_t at(size_t i) const { return (uint8_t)data[pos + i]; }
+  void append(const char* p, size_t n) {
+    if (pos > 65536 && pos > data.size() / 2) {  // lazy compaction
+      data.erase(0, pos);
+      pos = 0;
+    }
+    data.append(p, n);
+  }
+  void consume(size_t n) {
+    pos += n;
+    if (pos == data.size()) {
+      data.clear();  // keeps capacity: the buffer is the pool unit
+      pos = 0;
+    }
+  }
+  std::string take(size_t n) {
+    std::string s = data.substr(pos, n);
+    consume(n);
+    return s;
+  }
+  size_t find(char ch) const {
+    auto r = data.find(ch, pos);
+    return r == std::string::npos ? std::string::npos : r - pos;
+  }
+  std::string str() const { return data.substr(pos); }
+  void reset() {
+    data.clear();
+    pos = 0;
+  }
+};
+
+// Outbound block queue: frames coalesce into pooled blocks; a partial
+// write advances front_pos (no erase-from-front memmove). `bytes` is the
+// total queued — the bounded-outbound drop policy reads it.
+struct SendQueue {
+  std::deque<std::string> blocks;
+  size_t front_pos = 0;
+  size_t bytes = 0;
+  bool empty() const { return bytes == 0; }
+};
+
+// Bounded free-list of grown std::strings, reused across connections and
+// send blocks (ISSUE 10: firehose-rate conn churn must not pay a
+// malloc/free cycle per accept or per queued frame).
+class BufferPool {
+ public:
+  std::string acquire() {
+    if (bufs_.empty()) return std::string();
+    std::string s = std::move(bufs_.back());
+    bufs_.pop_back();
+    s.clear();
+    return s;
+  }
+  void release(std::string&& s) {
+    if (bufs_.size() < kMaxPooled && s.capacity() >= 512 &&
+        s.capacity() <= kMaxRetainedCap) {
+      bufs_.push_back(std::move(s));
+    }
+  }
+
+ private:
+  static constexpr size_t kMaxPooled = 64;
+  static constexpr size_t kMaxRetainedCap = 1u << 20;
+  std::vector<std::string> bufs_;
+};
+
+// One readiness event from the Poller backend. `tag` is whatever the
+// caller registered: a Conn* or one of the ReplicaServer sentinel tags.
+struct PollerEvent {
+  uint64_t tag;
+  bool readable;
+  bool writable;
+  bool error;
+};
+
+// Persistent-registration readiness backend (the ISSUE 10 tentpole):
+// register each fd ONCE at accept/dial, wait for events, deregister at
+// close — instead of rebuilding a pollfd array every loop iteration.
+// Two implementations in net.cc:
+//   EpollPoller — Linux, edge-triggered for connections (EPOLLIN |
+//                 EPOLLOUT | EPOLLET armed once; writes are flushed
+//                 eagerly at enqueue, so EPOLLOUT edges only matter
+//                 after a partial write), level-triggered for the
+//                 listener/metrics/verifier sentinels.
+//   PollPoller  — portable fallback (and the PBFT_NET_POLL=1 parity
+//                 lever): a pollfd table maintained INCREMENTALLY
+//                 (O(1) add/remove/write-interest via an fd index map),
+//                 so even the fallback never rebuilds per iteration.
+class Poller {
+ public:
+  virtual ~Poller() = default;
+  virtual const char* name() const = 0;
+  // `edge` requests edge-triggered read+write registration where the
+  // backend supports it; sentinel fds pass false (level-triggered read).
+  virtual bool add(int fd, uint64_t tag, bool edge) = 0;
+  virtual void remove(int fd) = 0;
+  // Level-triggered fallback only: arm/disarm write readiness for fd.
+  // No-op on the edge-triggered backend.
+  virtual void set_write_interest(int fd, bool want) = 0;
+  // Fills `out` with ready events; returns poll()/epoll_wait() semantics
+  // (<0 error, 0 timeout).
+  virtual int wait(std::vector<PollerEvent>* out, int timeout_ms) = 0;
+};
+
+// epoll on Linux unless PBFT_NET_POLL=1 (or epoll_create fails); the
+// portable poll() backend otherwise.
+std::unique_ptr<Poller> make_poller();
+
 // One buffered non-blocking TCP connection.
 struct Conn {
   int fd = -1;
-  std::string rbuf;
-  std::string wbuf;
+  RecvBuf rbuf;
+  SendQueue out;
   bool raw_json = false;   // client-gateway mode (sniffed: first byte '{')
   bool sniffed = false;
   bool closed = false;
@@ -65,6 +208,14 @@ struct Conn {
   // Frames sent before the offer arrives go as JSON; receivers detect
   // the codec per frame from the payload's first byte.
   bool codec_binary = false;
+  // Inbound link whose hello carried role=gateway (ISSUE 10): framed
+  // client requests arrive here, and replies for the clients it forwarded
+  // fan BACK over this same link instead of per-reply dial-backs.
+  bool gateway = false;
+  uint64_t link_id = 0;  // gateway_links_ key (stable across the map)
+  // Latch for pbft_write_backpressure_events_total: one count per
+  // backed-up episode, cleared when the queue drains.
+  bool backpressured = false;
   std::unique_ptr<SecureChannel> chan;
   std::vector<std::string> pending;  // outbound payloads queued pre-handshake
 };
@@ -131,6 +282,9 @@ class ReplicaServer {
 
   Replica& replica() { return *replica_; }
   int listen_port() const { return listen_port_; }
+  // Which readiness backend this server runs on ("epoll-et" or "poll") —
+  // the epoll-vs-poll parity arm in core_test asserts both paths.
+  const char* net_backend() const;
   // One JSON metrics line (counters + queue depths).
   std::string metrics_json() const;
 
@@ -194,6 +348,32 @@ class ReplicaServer {
  private:
   void accept_ready();
   void handle_readable(Conn& c);
+  // Register a freshly created conn with the poller (dials additionally
+  // arm write readiness for connect completion on the fallback backend).
+  void register_conn(Conn& c);
+  // Append framed bytes to c's outbound queue, coalescing into pooled
+  // blocks. Callers flush() afterwards (edge-triggered discipline: the
+  // eager flush IS the common write path; poller write events only
+  // resume after a partial write).
+  void queue_bytes(Conn& c, const std::string& framed);
+  // Bounded-outbound admission (ISSUE 10 satellite): false when the
+  // conn's queue is over budget — the frame is dropped and counted
+  // (PBFT retransmission absorbs the loss like any link drop).
+  bool outbound_has_room(Conn& c);
+  void count_backpressure();
+  // Route a reply over a gateway link (framed raw-JSON payload).
+  void send_gateway_reply(Conn& g, const std::string& payload);
+  // Remember which gateway link forwarded for `client` (bounded map).
+  void note_gateway_route(const std::string& client, uint64_t link_id);
+  // (De)register the in-flight async verifier fd with the poller. The fd
+  // may already be closed by the verifier at removal time; that is safe
+  // single-threaded (nothing reuses the number before the remove runs).
+  void register_verifier_fd();
+  void unregister_verifier_fd();
+  // End-of-iteration sweep: reap overdue nonblocking connects, erase
+  // closed conns (returning their buffers to the pool), refresh the
+  // connections-open gauge and the connecting count.
+  void sweep_conns();
   // Resolve an in-flight nonblocking connect (SO_ERROR check) and flush
   // whatever buffered while it completed.
   void finish_connect(Conn& c);
@@ -347,6 +527,26 @@ class ReplicaServer {
   int64_t replies_dropped_ = 0;  // overflow + TTL expiry (metrics_json)
   std::vector<std::unique_ptr<Conn>> conns_;       // accepted (inbound)
   std::map<int64_t, std::unique_ptr<Conn>> peers_;  // dialed (outbound)
+  // Readiness backend + per-iteration event scratch (ISSUE 10): fds are
+  // registered once at accept/dial and removed at close — no per-pass
+  // pollfd rebuild. Created in the constructor so every conn path can
+  // register unconditionally.
+  std::unique_ptr<Poller> poller_;
+  std::vector<PollerEvent> events_;
+  BufferPool pool_;  // reusable recv buffers + send blocks
+  int verifier_fd_ = -1;  // async verifier fd currently registered
+  size_t connecting_count_ = 0;  // nonblocking dials awaiting completion
+  int64_t event_wakeups_ = 0;        // poller wait() returns (metrics_json)
+  int64_t backpressure_events_ = 0;  // drops + backed-up episodes
+  // Gateway tier (ISSUE 10): live gateway links by id, and which link
+  // forwarded for each client token. Routes are a bounded cache — on
+  // overflow the map clears and un-routed "gw/" replies fall back to a
+  // fan-out over ALL gateway links (gateways drop tokens they don't own),
+  // so degradation is extra frames, never lost quorums.
+  std::map<uint64_t, Conn*> gateway_links_;
+  std::map<std::string, uint64_t> gateway_routes_;
+  uint64_t gateway_link_seq_ = 0;
+  int64_t gateway_forwarded_ = 0;  // requests received over gateway links
   int64_t batches_run_ = 0;
   int64_t frames_in_ = 0;
   // Serialize-once accounting (metrics_json + the counter-based invariant

@@ -458,6 +458,90 @@ void stress_chaos_cluster(int scale) {
   ::close(reply_fd);
 }
 
+// --- 7. connect/disconnect churn vs the edge-triggered loop ----------------
+//
+// ISSUE 10: the epoll rewrite registers fds once at accept/dial and
+// removes them at close — fd numbers recycle at churn rate, partial
+// frames park bytes in pooled recv buffers, and half-open dials hit the
+// connect-deadline sweep. This leg hammers one live server (its three
+// peers down, so its own outbound dials churn too) from several client
+// threads mixing instant disconnects, partial length prefixes, garbage,
+// and real requests — then proves the server still serves.
+void stress_conn_churn(int scale) {
+  int port = 0;
+  int hold = listen_on_ephemeral(&port);
+  CHECK(hold >= 0);
+  // n=4 config with only replica 0 alive: every broadcast dials dead
+  // peers, exercising the nonblocking-connect reap path under load.
+  int peer_ports[3];
+  int peer_holds[3];
+  for (int i = 0; i < 3; ++i) {
+    peer_holds[i] = listen_on_ephemeral(&peer_ports[i]);
+    CHECK(peer_holds[i] >= 0);
+  }
+  pbft::ClusterConfig cfg;
+  std::vector<std::vector<uint8_t>> seeds;
+  for (int i = 0; i < 4; ++i) {
+    std::vector<uint8_t> seed(32, (uint8_t)(i + 61));
+    pbft::ReplicaIdentity ident;
+    ident.replica_id = i;
+    ident.host = "127.0.0.1";
+    ident.port = i == 0 ? port : peer_ports[i - 1];
+    pbft::ed25519_public_key(ident.pubkey, seed.data());
+    cfg.replicas.push_back(ident);
+    seeds.push_back(seed);
+  }
+  ::close(hold);
+  for (int i = 0; i < 3; ++i) ::close(peer_holds[i]);  // peers stay down
+  pbft::ReplicaServer server(cfg, 0, seeds[0].data(),
+                             std::make_unique<pbft::CpuVerifier>());
+  CHECK(server.start());
+  std::thread loop([&server] { server.run(); });
+
+  const std::string addr = "127.0.0.1:" + std::to_string(port);
+  std::vector<std::thread> churners;
+  for (int t = 0; t < 4; ++t) {
+    churners.emplace_back([&, t] {
+      for (int i = 0; i < 250 * scale; ++i) {
+        int fd = pbft::dial_tcp(addr);
+        if (fd < 0) continue;
+        switch ((i + t) % 4) {
+          case 0:
+            break;  // instant disconnect: accept+register+EOF+remove
+          case 1: {  // partial length prefix parks bytes in the rbuf
+            uint8_t partial[2] = {0x00, 0x00};
+            (void)!::send(fd, partial, sizeof(partial), MSG_NOSIGNAL);
+            break;
+          }
+          case 2: {  // oversized frame header: server must drop us
+            uint8_t bad[4] = {0xFF, 0xFF, 0xFF, 0xFF};
+            (void)!::send(fd, bad, sizeof(bad), MSG_NOSIGNAL);
+            break;
+          }
+          default: {  // real raw-JSON request (no reply listener: the
+                      // dial-back goes to a dead port, churning the
+                      // reply-dial path as well)
+            const std::string req =
+                "{\"type\":\"client-request\",\"operation\":\"churn\","
+                "\"timestamp\":" + std::to_string(i + 1) +
+                ",\"client\":\"127.0.0.1:1\"}\n";
+            (void)!::send(fd, req.data(), req.size(), MSG_NOSIGNAL);
+            break;
+          }
+        }
+        ::close(fd);
+      }
+    });
+  }
+  for (auto& t : churners) t.join();
+  // The loop survived the churn: a fresh connection still gets served.
+  int fd = pbft::dial_tcp(addr);
+  CHECK(fd >= 0);
+  if (fd >= 0) ::close(fd);
+  server.stop();  // cross-thread: atomic stopping_
+  loop.join();
+}
+
 // --- 6. flight recorder: concurrent record vs dump/snapshot ---------------
 //
 // The black-box ring (core/flight.cc) is recorded from the poll loop and
@@ -536,6 +620,8 @@ int main(int argc, char** argv) {
   stress_flight_recorder(scale);
   std::printf("[race_stress] chaos cluster delay-queue pump...\n");
   stress_chaos_cluster(scale);
+  std::printf("[race_stress] connect/disconnect churn vs ET loop...\n");
+  stress_conn_churn(scale);
 
   if (g_failures) {
     std::fprintf(stderr, "%d failure(s)\n", g_failures);

@@ -77,6 +77,20 @@ M Replica::sign(M msg) const {
 
 Actions Replica::on_client_request(const ClientRequest& req) {
   Actions out;
+  // §4.1: EVERY replica remembers the last reply it sent each client and
+  // re-sends it on a retransmission of an executed request — backups
+  // included, BEFORE the forward-to-primary. The cached reply carries
+  // this replica's own signature, so f+1 retransmission answers form a
+  // distinct-voter quorum (the gateway fan-back depends on this: routing
+  // every duplicate's answer through the primary alone can never
+  // convince a client that f+1 replicas executed).
+  auto cached = last_reply_.find(req.client);
+  if (cached != last_reply_.end() &&
+      cached->second.timestamp == req.timestamp) {
+    counters["duplicate_requests"] += 1;
+    out.replies.push_back({req.client, cached->second});
+    return out;
+  }
   if (!is_primary()) {
     out.sends.push_back({primary(), Message(req)});
     return out;
@@ -84,11 +98,6 @@ Actions Replica::on_client_request(const ClientRequest& req) {
   auto it = last_timestamp_.find(req.client);
   if (it != last_timestamp_.end() && req.timestamp <= it->second) {
     counters["duplicate_requests"] += 1;
-    auto cached = last_reply_.find(req.client);
-    if (cached != last_reply_.end() &&
-        cached->second.timestamp == req.timestamp) {
-      out.replies.push_back({req.client, cached->second});
-    }
     return out;
   }
   // Duplicate suppression must also see the OPEN batch: a retransmission

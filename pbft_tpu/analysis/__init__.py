@@ -11,6 +11,8 @@ guards the dynamic behavior; this package is the static complement:
     async-blocking  no blocking calls inside ``async def`` in pbft_tpu/net
     metrics         every metric/trace emitter matches the manifest
                     (generalized successor of scripts/check_trace_schema)
+    sockets         TCP_NODELAY / SO_REUSEADDR at every stream-socket
+                    creation site in both runtimes (ISSUE 10)
 
 Entry point: ``scripts/pbft_lint.py`` (wired into tier-1 by
 tests/test_lint.py). Every pass takes a ``root`` so the tests can run
@@ -22,7 +24,7 @@ from __future__ import annotations
 import pathlib
 from typing import Callable, Dict, List
 
-from . import async_blocking, constants, metrics_lint
+from . import async_blocking, constants, metrics_lint, sockets
 
 REPO = pathlib.Path(__file__).resolve().parent.parent.parent
 
@@ -30,6 +32,7 @@ PASSES: Dict[str, Callable[[pathlib.Path], List[str]]] = {
     "constants": constants.check,
     "async-blocking": async_blocking.check,
     "metrics": metrics_lint.check,
+    "sockets": sockets.check,
 }
 
 
@@ -48,6 +51,7 @@ def scanned_files(root: pathlib.Path = REPO) -> List[pathlib.Path]:
     paths = [root / rel for rel in constants.files_scanned()]
     paths += async_blocking.files_scanned(root)
     paths += metrics_lint.files_scanned(root)
+    paths += sockets.files_scanned(root)
     out, seen = [], set()
     for p in paths:
         if p not in seen:

@@ -103,6 +103,18 @@ PAIRS: List[Tuple[str, Tuple[str, str], Tuple[str, str]]] = [
     ("verify-service status version",
      ("core/verifier.cc", "kStatusVersionLint"),  # custom, see below
      ("pbft_tpu/net/service.py", "STATUS_VERSION")),
+    # Gateway tier (ISSUE 10): the routing-token prefix both runtimes
+    # switch the reply path on, and the bounded-queue/route-cache sizes
+    # the backpressure and fan-back fallback policies share.
+    ("gateway client-token prefix",
+     ("core/net.h", "kGatewayClientPrefix"),
+     ("pbft_tpu/net/gateway.py", "GATEWAY_CLIENT_PREFIX")),
+    ("max per-connection outbound bytes",
+     ("core/net.cc", "kMaxConnOutbound"),
+     ("pbft_tpu/net/server.py", "MAX_CONN_OUTBOUND")),
+    ("gateway route-cache bound",
+     ("core/net.cc", "kMaxGatewayRoutes"),
+     ("pbft_tpu/net/server.py", "MAX_GATEWAY_ROUTES")),
 ]
 
 # Files consulted by extractors that are not simple name pairs.

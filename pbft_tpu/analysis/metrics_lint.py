@@ -52,6 +52,9 @@ PY_EMITTERS = {
     # send/first-reply/quorum stamps, ISSUE 9) — held to the same
     # manifest contract as the replica runtimes.
     "client.py": pathlib.Path("pbft_tpu/net/client.py"),
+    # The gateway tier (ISSUE 10): clients-open gauge, forwarded counter,
+    # and the shared backpressure counter — same manifest contract.
+    "gateway.py": pathlib.Path("pbft_tpu/net/gateway.py"),
 }
 # utils/metrics.py emits consensus_span on behalf of server.py (the spans
 # object is wired there); lint it under the server.py emitter identity.

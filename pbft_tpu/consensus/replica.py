@@ -275,15 +275,21 @@ class Replica:
     # -- client request path (reference src/behavior.rs:63-98) --------------
 
     def on_client_request(self, req: ClientRequest) -> List[Action]:
+        # §4.1: EVERY replica re-sends its cached reply on a
+        # retransmission of an executed request — backups included,
+        # BEFORE the forward-to-primary (mirrors core/replica.cc). The
+        # cached reply carries this replica's own signature, so f+1
+        # retransmission answers form a distinct-voter quorum.
+        cached = self.last_reply.get(req.client)
+        if cached is not None and cached.timestamp == req.timestamp:
+            self.counters["duplicate_requests"] += 1
+            return [Reply(req.client, cached)]
         if not self.is_primary:
             # Forward to the primary (reference TODO src/client_handler.rs:66-68).
             return [Send(self.primary, req)]
         last = self.last_timestamp.get(req.client)
         if last is not None and req.timestamp <= last:
             self.counters["duplicate_requests"] += 1
-            cached = self.last_reply.get(req.client)
-            if cached is not None and cached.timestamp == req.timestamp:
-                return [Reply(req.client, cached)]
             return []
         # Duplicate suppression must also see the OPEN batch: a
         # retransmission arriving while its first copy waits unsealed

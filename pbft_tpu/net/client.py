@@ -20,6 +20,16 @@ from ..consensus.config import ClusterConfig
 from ..consensus.messages import ClientReply, ClientRequest
 
 
+def _dial(host: str, port: int, timeout: float = 5.0) -> socket.socket:
+    """Every client dial goes through here: TCP_NODELAY on every stream
+    socket (ISSUE 10 satellite; scripts/pbft_lint.py analysis/sockets.py
+    statically requires it at each dial site) — a request is one small
+    write, and a Nagle stall on it dwarfs the consensus round."""
+    s = socket.create_connection((host, port), timeout=timeout)
+    s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    return s
+
+
 def _host_verify(pub: bytes, msg: bytes, sig: bytes) -> bool:
     """Native C++ verifier when built, else the Python oracle."""
     global _VERIFIER
@@ -49,6 +59,10 @@ class PbftClient:
         client = self
 
         class Handler(socketserver.StreamRequestHandler):
+            # TCP_NODELAY on accepted reply sockets too (ISSUE 10 socket
+            # discipline) — socketserver's built-in spelling of it.
+            disable_nagle_algorithm = True
+
             def handle(self):
                 data = self.rfile.read()
                 rx = time.monotonic()  # arrival stamp for first-reply latency
@@ -116,7 +130,7 @@ class PbftClient:
         )
         ident = self.config.identity(to_replica)
         self._stamp_send(timestamp)
-        with socket.create_connection((ident.host, ident.port), timeout=5) as s:
+        with _dial(ident.host, ident.port) as s:
             s.sendall(req.canonical() + b"\n")
         return req
 
@@ -145,7 +159,7 @@ class PbftClient:
         timestamps: List[int] = []
         inflight: List[Tuple[int, str]] = []  # (timestamp, operation)
         ident = self.config.identity(to_replica)
-        sock = socket.create_connection((ident.host, ident.port), timeout=5)
+        sock = _dial(ident.host, ident.port)
         try:
             next_op = 0
             while len(results) < len(operations):
@@ -177,9 +191,7 @@ class PbftClient:
                     for rid in range(self.config.n):
                         rident = self.config.identity(rid)
                         try:
-                            with socket.create_connection(
-                                (rident.host, rident.port), timeout=2
-                            ) as s:
+                            with _dial(rident.host, rident.port, timeout=2) as s:
                                 s.sendall(payload)
                         except OSError:
                             pass
@@ -227,9 +239,7 @@ class PbftClient:
         def send_to(rid: int) -> None:
             ident = self.config.identity(rid)
             try:
-                with socket.create_connection(
-                    (ident.host, ident.port), timeout=2
-                ) as s:
+                with _dial(ident.host, ident.port, timeout=2) as s:
                     s.sendall(payload)
             except OSError:
                 pass  # dead replica: that's what the rotation/broadcast is for

@@ -264,7 +264,10 @@ class LocalCluster:
                 try:
                     with socket.create_connection(
                         (ident.host, ident.port), timeout=0.2
-                    ):
+                    ) as probe:
+                        probe.setsockopt(
+                            socket.IPPROTO_TCP, socket.TCP_NODELAY, 1
+                        )
                         break
                 except OSError:
                     if time.monotonic() > deadline:

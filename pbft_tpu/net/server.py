@@ -52,6 +52,7 @@ from ..consensus.replica import (
 )
 from ..utils import ConsensusSpans, MetricsRegistry, get_tracer, start_metrics_server
 from . import secure
+from .gateway import GATEWAY_CLIENT_PREFIX
 
 
 def _frame_bytes(payload: bytes) -> bytes:
@@ -129,6 +130,15 @@ FAULT_MODES = ("sig-corrupt", "mute", "stutter", "equivocate")
 # suffix, recomputes the batch digest, and RE-SIGNS — both variants carry
 # valid signatures, which is what makes equivocation a real attack.
 EQUIV_SUFFIX = "#equiv"
+
+# Bounded per-connection outbound (ISSUE 10, mirrors core/net.cc
+# kMaxConnOutbound; constants lint): a frame that would grow a slow
+# reader's write buffer past this is dropped and counted — PBFT
+# retransmission absorbs the loss like any link drop.
+MAX_CONN_OUTBOUND = 8 << 20
+# Gateway route-cache bound (mirrors kMaxGatewayRoutes): on overflow the
+# cache clears and un-routed "gw/" replies fan out over all gateway links.
+MAX_GATEWAY_ROUTES = 1 << 17
 
 
 async def _read_frame(reader, timeout: float = 10.0) -> bytes:
@@ -268,6 +278,22 @@ class AsyncReplicaServer:
         # Recently broadcast messages, for the stutter mode's replays.
         self._stutter_history: List[Message] = []
         self._server: Optional[asyncio.Server] = None
+        # Gateway tier (ISSUE 10): inbound links whose hello carried
+        # role=gateway. Framed client requests arrive on them; replies for
+        # the clients they forwarded fan BACK over the same link instead
+        # of per-reply dial-backs. link id -> writer, plus the bounded
+        # client-token route cache (on overflow it clears and un-routed
+        # "gw/" replies fan out over every gateway link).
+        self._gateway_links: Dict[int, asyncio.StreamWriter] = {}
+        self._gateway_routes: Dict[str, int] = {}
+        self._gateway_link_seq = 0
+        self.gateway_forwarded = 0
+        # Event-loop + backpressure accounting (ISSUE 10): stream-read
+        # completions (the asyncio analogue of poller wakeups), and
+        # bounded-outbound drops against slow readers.
+        self.event_wakeups = 0
+        self.backpressure_events = 0
+        self._conns_open = 0
         # dest -> _PeerLink; guarded by a per-dest lock so one handshake
         # runs per destination and sealed-frame counters never interleave.
         self._peer_links: Dict[int, _PeerLink] = {}
@@ -353,6 +379,7 @@ class AsyncReplicaServer:
     async def _on_connection(
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
     ) -> None:
+        self._conn_delta(+1)
         try:
             first = await reader.read(1)
             if not first:
@@ -364,7 +391,48 @@ class AsyncReplicaServer:
         except (ConnectionError, asyncio.IncompleteReadError, asyncio.TimeoutError):
             pass
         finally:
+            self._conn_delta(-1)
             writer.close()
+
+    # -- scale-out accounting (ISSUE 10) -------------------------------------
+
+    def _conn_delta(self, d: int) -> None:
+        """Track open sockets (accepted + dialed peer links) and refresh
+        the pbft_connections_open gauge — parity with core/net.cc's
+        end-of-iteration sweep."""
+        self._conns_open += d
+        if self.metrics_registry.enabled:
+            self.metrics_registry.gauge("pbft_connections_open").set(
+                max(0, self._conns_open) + len(self._peer_links)
+            )
+
+    def _count_wakeup(self) -> None:
+        """One event-loop readiness wakeup serviced (a stream read
+        completed) — the asyncio analogue of a poller wait() return."""
+        self.event_wakeups += 1
+        if self.metrics_registry.enabled:
+            self.metrics_registry.counter("pbft_epoll_wakeups_total").inc()
+
+    def _count_backpressure(self) -> None:
+        self.backpressure_events += 1
+        if self.metrics_registry.enabled:
+            self.metrics_registry.counter(
+                "pbft_write_backpressure_events_total"
+            ).inc()
+
+    def _writer_has_room(self, writer: asyncio.StreamWriter) -> bool:
+        """Bounded-outbound admission (ISSUE 10 satellite, mirrors
+        core/net.cc): a frame that would grow a slow reader's transport
+        buffer past MAX_CONN_OUTBOUND is dropped and counted instead of
+        buffering without limit — retransmission absorbs the loss."""
+        try:
+            size = writer.transport.get_write_buffer_size()
+        except (AttributeError, RuntimeError):
+            return True
+        if size > MAX_CONN_OUTBOUND:
+            self._count_backpressure()
+            return False
+        return True
 
     # A raw-JSON client line may not exceed this; longer input is a
     # protocol violation (or an attack) and drops the connection instead
@@ -398,6 +466,7 @@ class AsyncReplicaServer:
             chunk = await reader.read(65536)
             if not chunk:
                 break
+            self._count_wakeup()
             buf += chunk
         self._ingest_client_line(buf)  # trailing JSON without newline
 
@@ -414,83 +483,145 @@ class AsyncReplicaServer:
         buf = first
         chan: Optional[secure.SecureChannel] = None
         hello_seen = False
-        while True:
-            while len(buf) < 4:
-                chunk = await reader.read(65536)
-                if not chunk:
-                    return
-                buf += chunk
-            n = int.from_bytes(buf[:4], "big")
-            if n > (1 << 24):
-                return  # corrupt frame
-            while len(buf) < 4 + n:
-                chunk = await reader.read(65536)
-                if not chunk:
-                    return
-                buf += chunk
-            payload, buf = buf[4 : 4 + n], buf[4 + n :]
-            if not hello_seen or (chan is not None and not chan.established):
-                try:
-                    obj = json.loads(payload)
-                except (ValueError, UnicodeDecodeError):
-                    obj = None
-                try:
-                    if not hello_seen:
-                        if not isinstance(obj, dict) or obj.get("type") != "hello":
-                            if self.secure:
-                                raise secure.HandshakeError(
-                                    "plaintext peer rejected: first frame "
-                                    "must be an encrypted-link hello"
-                                )
-                            # Plaintext cluster: tolerate a missing hello
-                            # (raw protocol frame) for tooling compat.
-                            hello_seen = True
-                        else:
-                            secure.SecureChannel.check_version(obj)
-                            hello_seen = True
-                            if self.secure:
-                                chan = secure.SecureChannel(
-                                    self.id,
-                                    self._seed,
-                                    self._pubkey_of,
-                                    initiator=False,
-                                )
-                                reply = chan.on_hello(obj)
-                                writer.write(_frame_obj(reply))
-                                await writer.drain()
-                            else:
-                                # Plaintext hello-ack: advertise this
-                                # node's version + codec offer so the
-                                # dialing peer can negotiate binary-v2
-                                # (a 1.0.0 initiator parses and ignores
-                                # any non-reject frame).
-                                writer.write(
-                                    _frame_obj(secure.plain_hello(self.id))
-                                )
-                                await writer.drain()
-                            continue
-                    elif chan is not None:
-                        if not isinstance(obj, dict) or obj.get("type") != "auth":
-                            raise secure.HandshakeError("expected auth frame")
-                        chan.on_auth(obj)
-                        continue
-                except secure.HandshakeError as e:
+        # Gateway link state (ISSUE 10): set when the hello carried
+        # role=gateway; cleaned up on disconnect so replies stop fanning
+        # to a dead link (stale routes fall back to the all-links fan-out,
+        # which skips the removed id).
+        gw_link_id: Optional[int] = None
+        try:
+            while True:
+                while len(buf) < 4:
+                    chunk = await reader.read(65536)
+                    if not chunk:
+                        return
+                    self._count_wakeup()
+                    buf += chunk
+                n = int.from_bytes(buf[:4], "big")
+                if n > (1 << 24):
+                    return  # corrupt frame
+                while len(buf) < 4 + n:
+                    chunk = await reader.read(65536)
+                    if not chunk:
+                        return
+                    self._count_wakeup()
+                    buf += chunk
+                payload, buf = buf[4 : 4 + n], buf[4 + n :]
+                if not hello_seen or (
+                    chan is not None and not chan.established
+                ):
                     try:
-                        writer.write(_frame_obj(secure.reject_payload(str(e))))
-                        await writer.drain()
-                    except (ConnectionError, OSError):
-                        pass
-                    return
-            if chan is not None:
+                        obj = json.loads(payload)
+                    except (ValueError, UnicodeDecodeError):
+                        obj = None
+                    try:
+                        if not hello_seen:
+                            if (
+                                not isinstance(obj, dict)
+                                or obj.get("type") != "hello"
+                            ):
+                                if self.secure:
+                                    raise secure.HandshakeError(
+                                        "plaintext peer rejected: first "
+                                        "frame must be an encrypted-link "
+                                        "hello"
+                                    )
+                                # Plaintext cluster: tolerate a missing
+                                # hello (raw protocol frame) for tooling
+                                # compat.
+                                hello_seen = True
+                            else:
+                                secure.SecureChannel.check_version(obj)
+                                hello_seen = True
+                                if obj.get("role") == "gateway":
+                                    # Gateway trust (ISSUE 10, parity with
+                                    # core/net.cc): framed client requests
+                                    # arrive on this link; replies for the
+                                    # clients it forwarded fan BACK over
+                                    # it. A gateway has no replica
+                                    # identity, so the signed-DH handshake
+                                    # cannot admit one: plaintext only.
+                                    if self.secure:
+                                        raise secure.HandshakeError(
+                                            "gateway links require a "
+                                            "plaintext cluster (a gateway "
+                                            "has no replica identity to "
+                                            "authenticate)"
+                                        )
+                                    self._gateway_link_seq += 1
+                                    gw_link_id = self._gateway_link_seq
+                                    self._gateway_links[gw_link_id] = writer
+                                if self.secure:
+                                    chan = secure.SecureChannel(
+                                        self.id,
+                                        self._seed,
+                                        self._pubkey_of,
+                                        initiator=False,
+                                    )
+                                    reply = chan.on_hello(obj)
+                                    writer.write(_frame_obj(reply))
+                                    await writer.drain()
+                                else:
+                                    # Plaintext hello-ack: advertise this
+                                    # node's version + codec offer so the
+                                    # dialing peer can negotiate binary-v2
+                                    # (a 1.0.0 initiator parses and
+                                    # ignores any non-reject frame).
+                                    writer.write(
+                                        _frame_obj(secure.plain_hello(self.id))
+                                    )
+                                    await writer.drain()
+                                continue
+                        elif chan is not None:
+                            if (
+                                not isinstance(obj, dict)
+                                or obj.get("type") != "auth"
+                            ):
+                                raise secure.HandshakeError(
+                                    "expected auth frame"
+                                )
+                            chan.on_auth(obj)
+                            continue
+                    except secure.HandshakeError as e:
+                        try:
+                            writer.write(
+                                _frame_obj(secure.reject_payload(str(e)))
+                            )
+                            await writer.drain()
+                        except (ConnectionError, OSError):
+                            pass
+                        return
+                if chan is not None:
+                    try:
+                        payload = chan.open_frame(payload)
+                    except secure.HandshakeError:
+                        return  # tampered/desynced stream: drop the conn
                 try:
-                    payload = chan.open_frame(payload)
-                except secure.HandshakeError:
-                    return  # tampered/desynced stream: drop the connection
-            try:
-                msg = decode_payload(payload)
-            except (ValueError, KeyError, json.JSONDecodeError):
-                continue
-            self._ingest(msg, payload)
+                    msg = decode_payload(payload)
+                except (ValueError, KeyError, json.JSONDecodeError):
+                    continue
+                if gw_link_id is not None and isinstance(msg, ClientRequest):
+                    # Remember the forwarding link so this client's reply
+                    # fans back over it (exact route; the "gw/" fan-out
+                    # fallback covers replicas that only saw the request
+                    # via pre-prepare).
+                    self._note_gateway_route(msg.client, gw_link_id)
+                    self.gateway_forwarded += 1
+                    if self.metrics_registry.enabled:
+                        self.metrics_registry.counter(
+                            "pbft_gateway_forwarded_total"
+                        ).inc()
+                self._ingest(msg, payload)
+        finally:
+            if gw_link_id is not None:
+                self._gateway_links.pop(gw_link_id, None)
+
+    def _note_gateway_route(self, client: str, link_id: int) -> None:
+        """Bounded route cache (mirrors core/net.cc note_gateway_route):
+        on overflow it CLEARS — un-routed replies degrade to the all-links
+        fan-out, extra frames but never lost quorums."""
+        if len(self._gateway_routes) >= MAX_GATEWAY_ROUTES:
+            self._gateway_routes.clear()
+        self._gateway_routes[client] = link_id
 
     def _on_view_event(self, ev: str, v: int) -> None:
         """Replica.view_hook target: stamp view-change span events."""
@@ -772,7 +903,13 @@ class AsyncReplicaServer:
                         req_ts=act.msg.timestamp,
                         view=act.msg.view,
                     )
-                loop.create_task(self._dial_reply(act.client, act.msg))
+                if act.client.startswith(GATEWAY_CLIENT_PREFIX):
+                    # Gateway-routed client (ISSUE 10): the "address" is a
+                    # routing token, never dialable — one framed write on
+                    # the persistent gateway link instead of a dial-back.
+                    self._gateway_reply(act.client, act.msg)
+                else:
+                    loop.create_task(self._dial_reply(act.client, act.msg))
         if self.metrics_registry.enabled:
             # Deltas of the replica's own counters: "executed" counts per
             # REQUEST, "rounds_executed" per sequence number — together
@@ -953,6 +1090,13 @@ class AsyncReplicaServer:
                     self.metrics_registry.counter(
                         "pbft_codec_json_frames_total"
                     ).inc()
+            # Bounded-outbound admission BEFORE the seal (ISSUE 10): a
+            # black-holed peer whose drain() never completes must not
+            # grow the transport buffer (or the task queue behind the
+            # link lock) without limit — and on secure links the drop
+            # must happen before the AEAD nonce is consumed.
+            if not self._writer_has_room(link.writer):
+                return  # drop-and-count: retransmission absorbs the loss
             if link.chan is not None:
                 # Per-peer sealing over the SHARED plaintext: the AEAD
                 # counter is per-link state, so only the seal (not the
@@ -963,6 +1107,29 @@ class AsyncReplicaServer:
                 await link.writer.drain()
             except (ConnectionError, OSError):
                 self._peer_links.pop(dest, None)
+
+    def _gateway_reply(self, client: str, reply: ClientReply) -> None:
+        """Fan a reply back over the gateway link that forwarded for
+        ``client`` (exact route), or over EVERY live gateway link when the
+        route is unknown/stale — gateways drop tokens they don't own, so
+        degradation is extra frames, never a lost reply quorum. Writes are
+        admission-checked (bounded outbound) and never awaited: a slow
+        gateway costs dropped replies, not a stalled replica."""
+        payload = _frame_bytes(reply.canonical())
+        wid = self._gateway_routes.get(client)
+        if wid is not None and wid in self._gateway_links:
+            writers = [self._gateway_links[wid]]
+        else:
+            if wid is not None:
+                self._gateway_routes.pop(client, None)  # stale route
+            writers = list(self._gateway_links.values())
+        for w in writers:
+            if w.is_closing() or not self._writer_has_room(w):
+                continue
+            try:
+                w.write(payload)
+            except (ConnectionError, OSError, RuntimeError):
+                pass
 
     async def _dial_reply(self, client_addr: str, reply: ClientReply) -> None:
         # One dial per address at a time — a LATER reply to the same
@@ -1091,6 +1258,15 @@ class AsyncReplicaServer:
             "broadcast_encodes": self.broadcast_encodes,
             "codec_binary_frames": self.codec_binary_frames,
             "codec_json_frames": self.codec_json_frames,
+            # Scale-out surface (ISSUE 10; parity with core/net.cc
+            # metrics_json).
+            "net_backend": "asyncio",
+            "connections_open": max(0, self._conns_open)
+            + len(self._peer_links),
+            "event_wakeups": self.event_wakeups,
+            "backpressure_events": self.backpressure_events,
+            "gateway_links": len(self._gateway_links),
+            "gateway_forwarded": self.gateway_forwarded,
             "faults_injected": self.faults_injected,
             "chaos_dropped": self.chaos_dropped,
             "executed_upto": self.replica.executed_upto,

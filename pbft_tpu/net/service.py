@@ -222,6 +222,15 @@ class VerifierService:
         service = self
 
         class Handler(socketserver.BaseRequestHandler):
+            def setup(self):
+                # TCP_NODELAY on accepted verify streams (ISSUE 10 socket
+                # discipline): the 1-byte-per-item verdict reply must not
+                # sit in a Nagle stall. Unix sockets have no Nagle.
+                if self.request.family == socket.AF_INET:
+                    self.request.setsockopt(
+                        socket.IPPROTO_TCP, socket.TCP_NODELAY, 1
+                    )
+
             def handle(self):  # one connection, many batches
                 sock = self.request
                 try:

@@ -451,7 +451,11 @@ def _dial(target: str, timeout: float) -> socket.socket:
         sock.connect(target)
         return sock
     host, port = target.rsplit(":", 1)
-    return socket.create_connection((host, int(port)), timeout=timeout)
+    sock = socket.create_connection((host, int(port)), timeout=timeout)
+    # Socket-option discipline (ISSUE 10): every TCP dial sets
+    # TCP_NODELAY — a 4-byte verify header must not sit in a Nagle stall.
+    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    return sock
 
 
 def probe_status(

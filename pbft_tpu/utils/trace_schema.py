@@ -193,6 +193,25 @@ METRIC_SCHEMAS = {
         "gauge",
         {"verify_service.py", "net.cc"},
     ),
+    # Scale-out surface (ISSUE 10). Replica side: live sockets, event-loop
+    # readiness wakeups (epoll_wait/poll returns in C++; stream read
+    # completions in asyncio), bounded-outbound drops + partial-write
+    # backpressure episodes, and client requests received over gateway
+    # links. Gateway side (pbft_tpu/net/gateway.py): downstream client
+    # connections open and requests forwarded upstream — the tier's
+    # multiplexing ratio is gateway_clients_open vs the replicas'
+    # connections_open.
+    "pbft_connections_open": ("gauge", {"server.py", "net.cc"}),
+    "pbft_epoll_wakeups_total": ("counter", {"server.py", "net.cc"}),
+    "pbft_write_backpressure_events_total": (
+        "counter",
+        {"server.py", "net.cc", "gateway.py"},
+    ),
+    "pbft_gateway_clients_open": ("gauge", {"gateway.py"}),
+    "pbft_gateway_forwarded_total": (
+        "counter",
+        {"gateway.py", "server.py", "net.cc"},
+    ),
     "pbft_batch_size": ("histogram", {"server.py", "net.cc"}),
     "pbft_verify_batch_size": ("histogram", {"server.py", "service.py", "net.cc"}),
     "pbft_verify_seconds": ("histogram", {"server.py", "service.py", "net.cc"}),

@@ -122,6 +122,42 @@ def compare(
     return report
 
 
+def group_runs(runs: List[dict], key: str) -> Dict[str, List[dict]]:
+    """Partition runs by a row field (e.g. ``replicas`` for the scale
+    curve): rows missing the field land in the "" group."""
+    groups: Dict[str, List[dict]] = {}
+    for row in runs:
+        groups.setdefault(str(row.get(key, "")), []).append(row)
+    return groups
+
+
+def compare_grouped(
+    old_runs: List[dict],
+    new_runs: List[dict],
+    key: str,
+    metrics: List[str],
+    max_regress_pct: float,
+    agg: str = "median",
+    lower_better: frozenset = frozenset(),
+) -> Dict[str, dict]:
+    """compare(), but per group of ``key`` (scripts/scale_curve.py emits
+    one row per cluster size; --group-by replicas gates each n's medians
+    and p99 separately instead of blurring the curve into one median).
+    Only groups present in BOTH files are compared; report keys are
+    ``<key>=<group>:<metric>``."""
+    old_groups = group_runs(old_runs, key)
+    new_groups = group_runs(new_runs, key)
+    report: Dict[str, dict] = {}
+    for g in sorted(old_groups.keys() & new_groups.keys()):
+        sub = compare(
+            old_groups[g], new_groups[g], metrics, max_regress_pct,
+            agg=agg, lower_better=lower_better,
+        )
+        for m, r in sub.items():
+            report[f"{key}={g}:{m}"] = r
+    return report
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter
@@ -156,6 +192,13 @@ def main(argv=None) -> int:
         "reply_p99_ms is treated as lower-better by default",
     )
     parser.add_argument(
+        "--group-by",
+        default=None,
+        help="partition runs by this row field and gate each group "
+        "separately (e.g. --group-by replicas for scale_curve.py output: "
+        "per-n medians and p99 instead of one blurred median)",
+    )
+    parser.add_argument(
         "--json", action="store_true", help="machine-readable report"
     )
     args = parser.parse_args(argv)
@@ -167,14 +210,21 @@ def main(argv=None) -> int:
         print(f"bench_compare: {e}", file=sys.stderr)
         return 2
     metrics = args.metric or list(DEFAULT_METRICS)
-    report = compare(
-        old_runs,
-        new_runs,
-        metrics,
-        args.max_regress_pct,
-        agg=args.agg,
-        lower_better=DEFAULT_LOWER_BETTER | frozenset(args.lower_better),
-    )
+    lower = DEFAULT_LOWER_BETTER | frozenset(args.lower_better)
+    if args.group_by:
+        report = compare_grouped(
+            old_runs, new_runs, args.group_by, metrics,
+            args.max_regress_pct, agg=args.agg, lower_better=lower,
+        )
+    else:
+        report = compare(
+            old_runs,
+            new_runs,
+            metrics,
+            args.max_regress_pct,
+            agg=args.agg,
+            lower_better=lower,
+        )
     if not report:
         print(
             f"bench_compare: no shared numeric metric among {metrics} "

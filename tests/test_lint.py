@@ -29,7 +29,12 @@ REPO = pathlib.Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO))
 
 from pbft_tpu import analysis  # noqa: E402
-from pbft_tpu.analysis import async_blocking, constants, metrics_lint  # noqa: E402
+from pbft_tpu.analysis import (  # noqa: E402
+    async_blocking,
+    constants,
+    metrics_lint,
+    sockets,
+)
 
 LINT = REPO / "scripts" / "pbft_lint.py"
 
@@ -192,6 +197,52 @@ def test_wrong_metric_kind_trips(tmp_path):
     errors = metrics_lint.check(root)
     assert any("pbft_executed_total" in e and "gauge" in e for e in errors), (
         errors)
+
+
+def test_untuned_python_dial_trips(tmp_path):
+    """sockets pass (ISSUE 10): stripping the TCP_NODELAY setsockopt from
+    the client's dial helper trips the socket-discipline lint."""
+    root = _shadow_tree(tmp_path)
+    cl = root / "pbft_tpu" / "net" / "client.py"
+    text = cl.read_text()
+    assert "TCP_NODELAY" in text
+    cl.write_text(
+        "\n".join(
+            line
+            for line in text.splitlines()
+            if "TCP_NODELAY" not in line
+        )
+    )
+    errors = sockets.check(root)
+    assert any("client.py" in e and "TCP_NODELAY" in e for e in errors), errors
+    proc = _run_lint(root, passes="sockets")
+    assert proc.returncode == 1
+
+
+def test_untuned_cxx_socket_trips(tmp_path):
+    """sockets pass, C++ side: a stream socket() site whose tuning call
+    is stripped fails the lint."""
+    root = _shadow_tree(tmp_path)
+    net = root / "core" / "net.cc"
+    text = net.read_text()
+    assert "tune_stream_socket(fd);" in text
+    # Strip the tune call inside dial_socket (the first occurrence after
+    # the AF_INET/SOCK_STREAM creation) — a new dial site forgetting the
+    # call looks exactly like this.
+    net.write_text(text.replace("  tune_stream_socket(fd);\n", "", 1))
+    errors = sockets.check(root)
+    assert any("net.cc" in e for e in errors), errors
+
+
+def test_divergent_gateway_prefix_trips(tmp_path):
+    """constants pass: the gateway routing-token prefix is a cross-runtime
+    switch (reply fan-back vs dial-back) — drift fails the build."""
+    root = _shadow_tree(tmp_path)
+    gw = root / "pbft_tpu" / "net" / "gateway.py"
+    gw.write_text(gw.read_text().replace(
+        'GATEWAY_CLIENT_PREFIX = "gw/"', 'GATEWAY_CLIENT_PREFIX = "gx/"'))
+    errors = constants.check(root)
+    assert any("gateway client-token prefix" in e for e in errors), errors
 
 
 def test_scanned_files_exist():
