@@ -34,6 +34,12 @@ enum FlightEvent : uint16_t {
   kFlightViewChangeSent = 9,
   kFlightNewViewInstalled = 10,
   kFlightVerifyBatch = 11,
+  // Perf-under-faults coverage (ISSUE 12): backoff-level change
+  // (seq = new level), explicit overload rejection (seq = request
+  // timestamp), and a gateway-fabric link replacement.
+  kFlightBackoffLevel = 12,
+  kFlightOverloadRejected = 13,
+  kFlightGatewayFailover = 14,
 };
 
 struct FlightRecord {

@@ -41,6 +41,10 @@ const char* kCounterNames[] = {
     // links.
     "pbft_epoll_wakeups_total", "pbft_write_backpressure_events_total",
     "pbft_gateway_forwarded_total",
+    // Perf-under-faults surface (ISSUE 12): explicit admission-control
+    // rejections and gateway-fabric link replacements (a replica losing a
+    // live gateway link).
+    "pbft_overload_rejections_total", "pbft_gateway_failovers_total",
 };
 const char* kGaugeNames[] = {
     "pbft_verify_queue_depth",
@@ -56,6 +60,9 @@ const char* kGaugeNames[] = {
     // Scale-out surface (ISSUE 10): live sockets (accepted + dialed),
     // refreshed by the end-of-iteration sweep.
     "pbft_connections_open",
+    // View-timer backoff level (ISSUE 12, §4.5.2): 1 = fresh, doubles
+    // per consecutive no-progress expiry — sustained high = no converge.
+    "pbft_view_timer_backoff_level",
 };
 // name -> uses the size bucket ladder (else latency).
 const std::pair<const char*, bool> kHistogramNames[] = {

@@ -594,10 +594,11 @@ void ReplicaServer::process_buffer(Conn& c) {
       if (msg) {
         ++frames_in_;
         metrics_.inc("pbft_frames_in_total");
-        if (auto* req = std::get_if<ClientRequest>(&*msg)) {
-          trace_request_rx(*req);
+        auto* req = std::get_if<ClientRequest>(&*msg);
+        if (req == nullptr || !maybe_reject_overload(*req)) {
+          if (req != nullptr) trace_request_rx(*req);
+          emit(replica_->receive(*msg));
         }
-        emit(replica_->receive(*msg));
       }
       if (c.rbuf.empty()) return;
     }
@@ -787,13 +788,16 @@ bool ReplicaServer::handle_peer_frame(Conn& c, std::string payload) {
       if (c.gateway) {
         // Remember the forwarding link so this client's reply can fan
         // back over it (exact route; the "gw/" prefix fallback covers
-        // replicas that only saw the request via pre-prepare).
+        // replicas that only saw the request via pre-prepare). Noted
+        // BEFORE admission so an overloaded line can route back too.
         note_gateway_route(req.client, c.link_id);
         ++gateway_forwarded_;
         metrics_.inc("pbft_gateway_forwarded_total");
       }
-      trace_request_rx(req);
-      emit(replica_->receive(*msg));
+      if (!maybe_reject_overload(req)) {
+        trace_request_rx(req);
+        emit(replica_->receive(*msg));
+      }
     } else {
       // Receive-side canonical reuse: derive the signable digest from
       // the framed bytes we already hold (sig-splice for JSON, fixed
@@ -822,7 +826,21 @@ void ReplicaServer::mark_closed(Conn& c) {
   c.rbuf = RecvBuf{};
   for (auto& b : c.out.blocks) pool_.release(std::move(b));
   c.out = SendQueue{};
-  if (c.gateway) gateway_links_.erase(c.link_id);
+  if (c.gateway) {
+    gateway_links_.erase(c.link_id);
+    if (!stopping_) {
+      // A live gateway link died (ISSUE 12): its clients must fail over
+      // to another gateway — count it so a chaos arm can attribute the
+      // blip.
+      ++gateway_failovers_;
+      metrics_.inc("pbft_gateway_failovers_total");
+      FlightRecorder& fl = global_flight();
+      if (fl.enabled()) {
+        fl.record(kFlightGatewayFailover, replica_->view(),
+                  (int64_t)c.link_id, -1);
+      }
+    }
+  }
   if (c.close_when_flushed) {
     if (reply_dials_in_flight_ > 0) --reply_dials_in_flight_;
     if (!c.reply_addr.empty()) reply_addrs_in_flight_.erase(c.reply_addr);
@@ -1506,6 +1524,8 @@ void ReplicaServer::check_progress_timer() {
   if (!pending) {
     timer_armed_ = false;
     timer_backoff_ = 1;
+    timer_retransmitted_ = false;
+    observe_backoff_level();
     return;
   }
   if (!timer_armed_) {
@@ -1521,10 +1541,36 @@ void ReplicaServer::check_progress_timer() {
       replica_->view() > timer_view_snapshot_) {
     // Progress happened; rearm fresh.
     timer_backoff_ = 1;
+    timer_retransmitted_ = false;
+  } else if (replica_->in_view_change() && !timer_retransmitted_) {
+    // First no-progress expiry while a view change pends (ISSUE 12):
+    // re-broadcast the pending VIEW-CHANGE verbatim instead of
+    // escalating — a lost VIEW-CHANGE/NEW-VIEW recovers in the SAME
+    // view (the primary-elect answers a retransmitted VIEW-CHANGE with
+    // its cached NEW-VIEW). Only the NEXT expiry escalates.
+    timer_retransmitted_ = true;
+    {
+      FlightRecorder& fl = global_flight();
+      if (fl.enabled()) {
+        fl.record(kFlightViewTimerFired, replica_->view(), timer_backoff_,
+                  -1);
+      }
+    }
+    if (trace_fp_) {
+      std::fprintf(trace_fp_,
+                   "{\"ts\":%.6f,\"ev\":\"view_timer_fired\",\"replica\":"
+                   "%lld,\"view\":%lld,\"backoff\":%d}\n",
+                   trace_now(), (long long)id_, (long long)replica_->view(),
+                   timer_backoff_);
+      std::fflush(trace_fp_);
+    }
+    emit(replica_->retransmit_view_change());
   } else {
-    // No progress within the timeout: suspect the primary. Exponential
-    // backoff keeps cascading view changes from thrashing (§4.5.2).
+    // No progress within the timeout (again): suspect the primary.
+    // Exponential backoff keeps cascading view changes from thrashing
+    // (§4.5.2).
     timer_backoff_ = std::min(timer_backoff_ * 2, 64);
+    timer_retransmitted_ = false;
     metrics_.inc("pbft_view_changes_total");
     // The view-change span opens here (ROADMAP item 4): timer fired ->
     // view_change_sent (Replica::view_hook) -> new_view_installed.
@@ -1546,7 +1592,18 @@ void ReplicaServer::check_progress_timer() {
     trace_view_change(timer_backoff_);
     emit(replica_->start_view_change());
   }
+  observe_backoff_level();
   timer_armed_ = false;  // rearmed on the next tick while work pends
+}
+
+void ReplicaServer::observe_backoff_level() {
+  if (timer_backoff_ == gauged_backoff_) return;
+  gauged_backoff_ = timer_backoff_;
+  metrics_.set_gauge("pbft_view_timer_backoff_level", (double)timer_backoff_);
+  FlightRecorder& fl = global_flight();
+  if (fl.enabled()) {
+    fl.record(kFlightBackoffLevel, replica_->view(), timer_backoff_, -1);
+  }
 }
 
 int ReplicaServer::peer_fd(int64_t dest) {
@@ -1731,6 +1788,11 @@ void ReplicaServer::dial_reply(const std::string& client_addr,
     out.sig.assign(out.sig.size(), 'f');
     count_fault();
   }
+  send_client_line(client_addr, out.to_json().dump());
+}
+
+void ReplicaServer::send_client_line(const std::string& client_addr,
+                                     const std::string& payload) {
   if (client_addr.compare(0, 3, kGatewayClientPrefix) == 0) {
     // Gateway-routed client (ISSUE 10): the "address" is a routing
     // token, never dialable. Exact route when this replica saw the
@@ -1738,7 +1800,6 @@ void ReplicaServer::dial_reply(const std::string& client_addr,
     // gateway link (gateways drop tokens they don't own) — a backup
     // that only saw the request via pre-prepare still reaches the
     // client's gateway for the f+1 reply quorum.
-    std::string payload = out.to_json().dump();
     auto rt = gateway_routes_.find(client_addr);
     if (rt != gateway_routes_.end()) {
       auto g = gateway_links_.find(rt->second);
@@ -1755,7 +1816,40 @@ void ReplicaServer::dial_reply(const std::string& client_addr,
     for (auto& [_, g] : gateway_links_) send_gateway_reply(*g, payload);
     return;
   }
-  start_reply_dial(client_addr, out.to_json().dump() + "\n");
+  start_reply_dial(client_addr, payload + "\n");
+}
+
+bool ReplicaServer::maybe_reject_overload(const ClientRequest& req) {
+  if (cfg_.admission_inflight <= 0 && cfg_.admission_backlog <= 0)
+    return false;
+  const int64_t last = replica_->client_last_timestamp(req.client);
+  if (req.timestamp <= last) return false;  // retransmission: cache answers
+  bool reject = cfg_.admission_inflight > 0 &&
+                req.timestamp - last > cfg_.admission_inflight;
+  if (!reject && cfg_.admission_backlog > 0) {
+    const int64_t backlog =
+        (int64_t)replica_->pending_count() + replica_->seal_backlog();
+    reject = backlog > cfg_.admission_backlog;
+  }
+  if (!reject) return false;
+  ++overload_rejections_;
+  metrics_.inc("pbft_overload_rejections_total");
+  {
+    FlightRecorder& fl = global_flight();
+    if (fl.enabled()) {
+      fl.record(kFlightOverloadRejected, replica_->view(), req.timestamp, -1);
+    }
+  }
+  // Explicit overloaded line toward the client (mirrors net/server.py).
+  // Built via Json (never format-string field literals): the metrics
+  // lint reads net.cc's escaped-quote tokens as trace-event fields.
+  JsonObject o;
+  o["type"] = Json(std::string("overloaded"));
+  o["client"] = Json(req.client);
+  o["timestamp"] = Json(req.timestamp);
+  o["replica"] = Json(id_);
+  send_client_line(req.client, Json(o).dump());
+  return true;
 }
 
 // At most this many one-shot reply dials in flight: a pipelined burst can
@@ -1855,6 +1949,10 @@ std::string ReplicaServer::metrics_json() const {
   o["backpressure_events"] = Json(backpressure_events_);
   o["gateway_links"] = Json((int64_t)gateway_links_.size());
   o["gateway_forwarded"] = Json(gateway_forwarded_);
+  // Perf-under-faults surface (ISSUE 12).
+  o["overload_rejections"] = Json(overload_rejections_);
+  o["gateway_failovers"] = Json(gateway_failovers_);
+  o["view_timer_backoff"] = Json((int64_t)timer_backoff_);
   o["verify_batches"] = Json(batches_run_);
   o["broadcasts"] = Json(broadcasts_);
   o["broadcast_encodes"] = Json(broadcast_encodes_);

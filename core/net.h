@@ -404,6 +404,16 @@ class ReplicaServer {
   // reuse (or lazily compute) the encoding, seal per peer, flush.
   void send_encoded(int64_t dest, EncodedOut& enc);
   void dial_reply(const std::string& client_addr, const ClientReply& reply);
+  // One raw-JSON line toward a client, by whatever channel its address
+  // names: the gateway link that forwarded for a "gw/" token (exact
+  // route, else fan-out), or a one-shot dial-back. Shared by replies and
+  // the ISSUE 12 overloaded notices.
+  void send_client_line(const std::string& client_addr,
+                        const std::string& payload);
+  // Admission control at client-request ingest (ISSUE 12): true when the
+  // request was rejected (explicit overloaded line sent, request
+  // dropped). Retransmissions always pass. Mirrors net/server.py.
+  bool maybe_reject_overload(const ClientRequest& req);
   // Start one reply dial (nonblocking) if the in-flight budget allows,
   // else queue it in reply_backlog_.
   void start_reply_dial(const std::string& addr, std::string payload);
@@ -489,6 +499,12 @@ class ReplicaServer {
   // Recently broadcast messages, for the stutter mode's stale replays.
   std::deque<Message> stutter_history_;
   int timer_backoff_ = 1;
+  // One VIEW-CHANGE retransmission per backoff level before escalating
+  // (ISSUE 12): a deadline expiry mid-view-change first re-broadcasts
+  // the pending VIEW-CHANGE verbatim (lost-frame recovery in the SAME
+  // view); only the NEXT no-progress expiry escalates and doubles.
+  bool timer_retransmitted_ = false;
+  int gauged_backoff_ = 1;  // last level pushed to the gauge/flight ring
   std::chrono::steady_clock::time_point timer_deadline_{};
   // State-transfer retry keeps its own deadline: the view-change timer may
   // hold a stale backed-off deadline (up to 64x vc_timeout) that must not
@@ -547,6 +563,13 @@ class ReplicaServer {
   std::map<std::string, uint64_t> gateway_routes_;
   uint64_t gateway_link_seq_ = 0;
   int64_t gateway_forwarded_ = 0;  // requests received over gateway links
+  // Perf-under-faults surface (ISSUE 12): explicit admission rejections
+  // and live gateway links lost mid-run (their clients must fail over).
+  int64_t overload_rejections_ = 0;
+  int64_t gateway_failovers_ = 0;
+  // Observe the backoff level into the gauge + flight ring when it
+  // changes (the chaos bench's storm signal).
+  void observe_backoff_level();
   int64_t batches_run_ = 0;
   int64_t frames_in_ = 0;
   // Serialize-once accounting (metrics_json + the counter-based invariant

@@ -542,6 +542,105 @@ void stress_conn_churn(int scale) {
   loop.join();
 }
 
+// --- 8. gateway-failover churn (ISSUE 12) ----------------------------------
+//
+// The gateway tier's failure surface: role=gateway links that die and
+// re-dial under load. Each churner thread plays a short-lived gateway —
+// framed hello with role=gateway, a burst of framed client requests under
+// its own gw/ tokens, a brief read of fanned-back replies — then kills
+// the link abruptly (exercising the gateway_failovers accounting, route
+// invalidation, and the reply fan-out fallback) and dials again. Runs
+// against one live server with dead peers, stopped cross-thread.
+void stress_gateway_failover(int scale) {
+  int port = 0;
+  int hold = listen_on_ephemeral(&port);
+  CHECK(hold >= 0);
+  int peer_ports[3];
+  int peer_holds[3];
+  for (int i = 0; i < 3; ++i) {
+    peer_holds[i] = listen_on_ephemeral(&peer_ports[i]);
+    CHECK(peer_holds[i] >= 0);
+  }
+  pbft::ClusterConfig cfg;
+  std::vector<std::vector<uint8_t>> seeds;
+  for (int i = 0; i < 4; ++i) {
+    std::vector<uint8_t> seed(32, (uint8_t)(i + 87));
+    pbft::ReplicaIdentity ident;
+    ident.replica_id = i;
+    ident.host = "127.0.0.1";
+    ident.port = i == 0 ? port : peer_ports[i - 1];
+    pbft::ed25519_public_key(ident.pubkey, seed.data());
+    cfg.replicas.push_back(ident);
+    seeds.push_back(seed);
+  }
+  // Admission control on, so the overload-rejection path (send_client_line
+  // over a gateway link, then over a freshly dead one) churns too.
+  cfg.admission_inflight = 4;
+  ::close(hold);
+  for (int i = 0; i < 3; ++i) ::close(peer_holds[i]);  // peers stay down
+  pbft::ReplicaServer server(cfg, 0, seeds[0].data(),
+                             std::make_unique<pbft::CpuVerifier>());
+  CHECK(server.start());
+  std::thread loop([&server] { server.run(); });
+
+  auto frame = [](const std::string& payload) {
+    uint32_t n = (uint32_t)payload.size();
+    std::string out;
+    out.push_back((char)(n >> 24));
+    out.push_back((char)(n >> 16));
+    out.push_back((char)(n >> 8));
+    out.push_back((char)n);
+    out += payload;
+    return out;
+  };
+  const std::string addr = "127.0.0.1:" + std::to_string(port);
+  std::vector<std::thread> gateways;
+  for (int t = 0; t < 3; ++t) {
+    gateways.emplace_back([&, t] {
+      for (int i = 0; i < 60 * scale; ++i) {
+        int fd = pbft::dial_tcp(addr);
+        if (fd < 0) continue;
+        // role=gateway hello (the trust switch), built from the real
+        // version constant so check_version admits it.
+        std::string hello =
+            std::string("{\"node\":-1,\"role\":\"gateway\",\"type\":"
+                        "\"hello\",\"ver\":\"") +
+            pbft::kProtocolVersion + "\"}";
+        std::string burst = frame(hello);
+        // A burst of fresh requests under this thread's own tokens —
+        // some past the admission cap, so overloaded lines fan back over
+        // this very link (and sometimes over a link we just killed).
+        for (int r = 0; r < 8; ++r) {
+          std::string req =
+              "{\"type\":\"client-request\",\"operation\":\"gwchurn\","
+              "\"timestamp\":" + std::to_string(i * 8 + r + 1) +
+              ",\"client\":\"gw/stress-" + std::to_string(t) + "-" +
+              std::to_string(i % 4) + "\"}";
+          burst += frame(req);
+        }
+        (void)!::send(fd, burst.data(), burst.size(), MSG_NOSIGNAL);
+        if ((i + t) % 3 != 0) {
+          // Briefly drain fanned-back frames (replies/overloaded lines),
+          // then die mid-stream like a crashed gateway.
+          char sink[4096];
+          pollfd p{fd, POLLIN, 0};
+          if (::poll(&p, 1, 2) > 0) {
+            (void)!::recv(fd, sink, sizeof(sink), MSG_DONTWAIT);
+          }
+        }
+        ::close(fd);  // abrupt death: route invalidation + failover count
+      }
+    });
+  }
+  for (auto& t : gateways) t.join();
+  // The loop survived the churn: a fresh connection still gets served.
+  int fd = pbft::dial_tcp(addr);
+  CHECK(fd >= 0);
+  if (fd >= 0) ::close(fd);
+  server.stop();  // cross-thread: atomic stopping_
+  loop.join();
+}
+
 // --- 6. flight recorder: concurrent record vs dump/snapshot ---------------
 //
 // The black-box ring (core/flight.cc) is recorded from the poll loop and
@@ -622,6 +721,8 @@ int main(int argc, char** argv) {
   stress_chaos_cluster(scale);
   std::printf("[race_stress] connect/disconnect churn vs ET loop...\n");
   stress_conn_churn(scale);
+  std::printf("[race_stress] gateway-failover churn...\n");
+  stress_gateway_failover(scale);
 
   if (g_failures) {
     std::fprintf(stderr, "%d failure(s)\n", g_failures);

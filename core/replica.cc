@@ -30,6 +30,10 @@ std::optional<ClusterConfig> ClusterConfig::from_json_text(
     cfg.batch_max_items = v->as_int();
   if (const Json* v = j->find("batch_flush_us"))
     cfg.batch_flush_us = v->as_int();
+  if (const Json* v = j->find("admission_inflight"))
+    cfg.admission_inflight = v->as_int();
+  if (const Json* v = j->find("admission_backlog"))
+    cfg.admission_backlog = v->as_int();
   if (const Json* v = j->find("verifier"); v && v->is_string())
     cfg.verifier = v->as_string();
   if (const Json* v = j->find("secure")) cfg.secure = v->as_bool();
@@ -91,13 +95,27 @@ Actions Replica::on_client_request(const ClientRequest& req) {
     out.replies.push_back({req.client, cached->second});
     return out;
   }
-  if (!is_primary()) {
-    out.sends.push_back({primary(), Message(req)});
-    return out;
+  // A timestamp at or below the client's last EXECUTED one can never
+  // execute again (per-client exactly-once) and its reply is no longer
+  // cached: drop it on EVERY role (ISSUE 12). Backups used to forward
+  // these forever — each forward re-armed the request timer for a
+  // request with nothing left to order, and a client stuck
+  // retransmitting a superseded timestamp could drive perpetual view
+  // changes out of pure duplicate traffic.
+  {
+    auto it = last_timestamp_.find(req.client);
+    if (it != last_timestamp_.end() && req.timestamp <= it->second) {
+      counters["duplicate_requests"] += 1;
+      return out;
+    }
   }
-  auto it = last_timestamp_.find(req.client);
-  if (it != last_timestamp_.end() && req.timestamp <= it->second) {
-    counters["duplicate_requests"] += 1;
+  if (!is_primary()) {
+    // Forward to the primary, and REMEMBER the request: if this view
+    // dies before it executes, enter_new_view re-aims it at the new
+    // primary (ISSUE 12 — see kMaxForwardedRetained).
+    if (forwarded_.size() >= kMaxForwardedRetained) forwarded_.clear();
+    forwarded_[req.client] = req;
+    out.sends.push_back({primary(), Message(req)});
     return out;
   }
   // Duplicate suppression must also see the OPEN batch: a retransmission
@@ -415,6 +433,7 @@ Actions Replica::drain_executions() {
         blake2b_256(state_digest_, buf.data(), buf.size());
       }
       last_timestamp_[req.client] = req.timestamp;
+      forwarded_.erase(req.client);  // executed: retire the re-aim entry
       ClientReply reply;
       reply.view = view;
       reply.timestamp = req.timestamp;
@@ -658,9 +677,21 @@ Actions Replica::start_view_change(int64_t new_view) {
   vc.prepared_proofs = prepared_proofs();
   vc.replica = id_;
   vc = sign(vc);
+  my_view_change_ = vc;
   Actions out;
   out.broadcasts.push_back({Message(vc)});
   out.merge(on_view_change(vc));  // log our own
+  return out;
+}
+
+Actions Replica::retransmit_view_change() {
+  // Verbatim re-broadcast (ISSUE 12): no counter moves, nothing is
+  // re-signed; receivers treat it as the duplicate it is, and a
+  // primary-elect that already sent NEW-VIEW answers with the cached
+  // NEW-VIEW (see on_view_change) — lost-frame recovery in the SAME view.
+  if (!in_view_change_ || !my_view_change_) return {};
+  Actions out;
+  out.broadcasts.push_back({Message(*my_view_change_)});
   return out;
 }
 
@@ -766,7 +797,22 @@ bool Replica::validate_view_change(const ViewChange& vc) const {
 }
 
 Actions Replica::on_view_change(const ViewChange& vc) {
-  if (vc.new_view <= view_) return {};
+  if (vc.new_view <= view_) {
+    // A VIEW-CHANGE for a view we already lead means the sender missed
+    // our NEW-VIEW broadcast (lost frame, or its retransmission timer):
+    // resend the cached message point-to-point — no recomputation, no
+    // re-broadcast (ISSUE 12 NEW-VIEW retransmission/suppression).
+    if (vc.new_view == view_ && config_.primary_of(vc.new_view) == id_ &&
+        vc.replica != id_ && vc.replica >= 0 && vc.replica < config_.n()) {
+      auto it = new_view_sent_.find(vc.new_view);
+      if (it != new_view_sent_.end()) {
+        Actions out;
+        out.sends.push_back({vc.replica, Message(it->second)});
+        return out;
+      }
+    }
+    return {};
+  }
   auto& slot = view_changes_[vc.new_view];
   if (slot.count(vc.replica)) return {};
   if (!validate_view_change(vc)) return {};
@@ -897,7 +943,7 @@ Actions Replica::maybe_new_view(int64_t v) {
   for (const auto& pp : pps) nv.pre_prepares.push_back(pp.to_json());
   nv.replica = id_;
   nv = sign(nv);
-  new_view_sent_.insert(v);
+  new_view_sent_.emplace(v, nv);
   Actions out;
   out.broadcasts.push_back({Message(nv)});
   out.merge(enter_new_view(v, min_s, stable_vc_for(vcs, min_s, config_.f()), pps));
@@ -949,6 +995,14 @@ Actions Replica::enter_new_view(int64_t v, int64_t min_s,
   view_ = v;
   in_view_change_ = false;
   pending_view_ = 0;
+  my_view_change_.reset();
+  // Keep only the NEW-VIEW for the view we just entered (a laggard's
+  // retransmitted VIEW-CHANGE may still ask for it); older entries can
+  // never be requested again.
+  for (auto it = new_view_sent_.begin(); it != new_view_sent_.end();) {
+    if (it->first < v) it = new_view_sent_.erase(it);
+    else ++it;
+  }
   sealed_ts_.clear();  // per-view primary ordering memory
   counters["view_changes_completed"] += 1;
   if (view_hook) view_hook("new_view_installed", v);
@@ -999,6 +1053,34 @@ Actions Replica::enter_new_view(int64_t v, int64_t min_s,
   prune_old_views(prepares_);
   prune_old_views(commits_);
   for (const auto& pp : pps) out.merge(on_pre_prepare(pp));
+  // Re-aim forwarded-but-unexecuted client requests at the NEW primary
+  // (ISSUE 12): a request forwarded to a primary that was just voted
+  // out evaporated with the old view — without this the only recovery
+  // is the client's retransmission timer, and until it fires the
+  // request timers keep escalating further view changes with nothing to
+  // order (the storm the chaos bench measures). Exactly-once is
+  // untouched: duplicates die on the per-client timestamp guards.
+  {
+    std::vector<ClientRequest> reaim;
+    for (auto it = forwarded_.begin(); it != forwarded_.end();) {
+      auto last = last_timestamp_.find(it->first);
+      if (last != last_timestamp_.end() &&
+          it->second.timestamp <= last->second) {
+        it = forwarded_.erase(it);  // already executed
+        continue;
+      }
+      reaim.push_back(it->second);
+      ++it;
+    }
+    const int64_t new_primary = config_.primary_of(v);
+    for (const auto& req : reaim) {
+      if (new_primary == id_) {
+        out.merge(on_client_request(req));
+      } else {
+        out.sends.push_back({new_primary, Message(req)});
+      }
+    }
+  }
   return out;
 }
 

@@ -25,6 +25,16 @@
 
 namespace pbft {
 
+// Forwarded-request retention bound (ISSUE 12, mirrors
+// consensus/replica.py MAX_FORWARDED_RETAINED; constants lint): a backup
+// remembers the last request it forwarded per client so a view change
+// can RE-AIM it at the new primary — without this, a request forwarded
+// to a primary that then gets voted out evaporates with the old view,
+// and until the client's retransmission timer fires the request timers
+// keep escalating view changes with nothing to order. On overflow the
+// map clears: retransmission covers the forgotten entries.
+inline constexpr size_t kMaxForwardedRetained = 1024;
+
 struct ReplicaIdentity {
   int64_t replica_id = 0;
   std::string host;
@@ -53,6 +63,15 @@ struct ClusterConfig {
   // pass). Backups ignore both: acceptance is size-agnostic.
   int64_t batch_max_items = 1;
   int64_t batch_flush_us = 0;
+  // Admission control (ISSUE 12, mirrors pbft_tpu/consensus/config.py):
+  // admission_inflight caps one client's estimated in-flight requests
+  // (its request timestamp's distance past the last executed one);
+  // admission_backlog watermarks the replica's own backlog (verify inbox
+  // + sealed-but-unexecuted sequences). A fresh request past either
+  // bound is answered with an explicit {"type": "overloaded"} line and
+  // dropped; retransmissions always pass. 0 disables either check.
+  int64_t admission_inflight = 0;
+  int64_t admission_backlog = 0;
   std::string verifier = "cpu";  // "cpu" | "host:port" | "/unix/path"
   // Encrypted replica-replica links (core/secure.cc; the reference's
   // development_transport bundles Noise on every link, src/main.rs:42).
@@ -123,8 +142,23 @@ class Replica {
   // View change (PBFT §4.4): called by the runtime when its request timer
   // for the current primary expires. new_view < 0 means "next view".
   Actions start_view_change(int64_t new_view = -1);
+  // Re-broadcast the pending VIEW-CHANGE verbatim (runtime retransmission
+  // timer, ISSUE 12): under link loss this converges in the SAME view
+  // where escalating would burn a view number per lost frame. No counter
+  // moves, nothing is re-signed. Empty when no view change pends.
+  Actions retransmit_view_change();
   bool in_view_change() const { return in_view_change_; }
   int64_t view() const { return view_; }
+  // Admission-control inputs (ISSUE 12, read by the net layer): the
+  // client's last EXECUTED timestamp (0 = never seen) and the count of
+  // sealed-but-unexecuted sequences on this replica.
+  int64_t client_last_timestamp(const std::string& client) const {
+    auto it = last_timestamp_.find(client);
+    return it == last_timestamp_.end() ? 0 : it->second;
+  }
+  int64_t seal_backlog() const {
+    return seq_counter_ > executed_upto_ ? seq_counter_ - executed_upto_ : 0;
+  }
   // True when accepted pre-prepares (or committed-but-unexecuted slots)
   // sit above executed_upto — the net layer's request-timer signal.
   bool has_unexecuted() const;
@@ -243,6 +277,10 @@ class Replica {
   // per client, so duplicate suppression sees unsealed requests too.
   std::vector<ClientRequest> open_batch_;
   std::map<std::string, int64_t> open_batch_ts_;
+  // Last request forwarded to the primary, per client (backup role;
+  // ISSUE 12): re-aimed at the new primary on view entry, retired at
+  // execution. Bounded by kMaxForwardedRetained.
+  std::map<std::string, ClientRequest> forwarded_;
   // Highest timestamp per client SEALED under a sequence in the current
   // view (primary duplicate check between seal and execution; cleared on
   // view entry so abandoned-view requests stay re-orderable).
@@ -261,7 +299,15 @@ class Replica {
   bool in_view_change_ = false;
   int64_t pending_view_ = 0;
   std::map<int64_t, std::map<int64_t, ViewChange>> view_changes_;
-  std::set<int64_t> new_view_sent_;
+  // NEW-VIEW messages this replica (as primary-elect) already built,
+  // keyed by view (ISSUE 12): membership suppresses redundant
+  // recomputation, and the cached message is RESENT point-to-point to a
+  // replica whose retransmitted VIEW-CHANGE shows it missed the
+  // broadcast. Pruned to views >= current on view entry.
+  std::map<int64_t, NewView> new_view_sent_;
+  // Our own latest VIEW-CHANGE (pending view) for the runtime's
+  // retransmission timer; cleared on view entry.
+  std::optional<ViewChange> my_view_change_;
   JsonArray stable_proof_;  // 2f+1 checkpoint dicts @ low_mark (C)
 };
 

@@ -99,6 +99,20 @@ PAIRS: List[Tuple[str, Tuple[str, str], Tuple[str, str]]] = [
     ("ClusterConfig default: batch_flush_us",
      ("core/replica.h", "batch_flush_us"),
      ("pbft_tpu/consensus/config.py", "batch_flush_us")),
+    # Admission control (ISSUE 12): per-client in-flight cap + global
+    # backlog watermark — a sparse network.json must disable both
+    # identically in either runtime.
+    ("ClusterConfig default: admission_inflight",
+     ("core/replica.h", "admission_inflight"),
+     ("pbft_tpu/consensus/config.py", "admission_inflight")),
+    ("ClusterConfig default: admission_backlog",
+     ("core/replica.h", "admission_backlog"),
+     ("pbft_tpu/consensus/config.py", "admission_backlog")),
+    # ISSUE 12: forwarded-request retention (view-change re-aim) bound —
+    # same eviction point in both runtimes or their storm behavior forks.
+    ("forwarded-request retention bound",
+     ("core/replica.h", "kMaxForwardedRetained"),
+     ("pbft_tpu/consensus/replica.py", "MAX_FORWARDED_RETAINED")),
     # Verify-service readiness handshake record shape.
     ("verify-service status version",
      ("core/verifier.cc", "kStatusVersionLint"),  # custom, see below

@@ -50,6 +50,18 @@ class ClusterConfig:
     # primary's choice, acceptance is size-agnostic.
     batch_max_items: int = 1
     batch_flush_us: int = 0
+    # Admission control (ISSUE 12): explicit overload replies instead of
+    # silent queueing into the tail. admission_inflight caps ONE client's
+    # estimated in-flight requests (its request timestamp's distance past
+    # the last executed one — client timestamps are consecutive, so the
+    # distance IS the pipeline depth); admission_backlog watermarks the
+    # replica's own backlog (verify inbox + sealed-but-unexecuted
+    # sequences). A fresh request past either bound is answered with
+    # {"type": "overloaded"} and dropped — clients back off with jitter
+    # (net/client.py request_with_retry). Retransmissions always pass
+    # (liveness must never be admission-gated). 0 disables either check.
+    admission_inflight: int = 0
+    admission_backlog: int = 0
     verifier: str = "cpu"  # "cpu" | "tpu"
     # Encrypted replica-replica links (signed-ephemeral DH + AEAD framing,
     # pbft_tpu/net/secure.py) — the reference's development_transport
@@ -80,6 +92,8 @@ class ClusterConfig:
                 "verify_flush_items": self.verify_flush_items,
                 "batch_max_items": self.batch_max_items,
                 "batch_flush_us": self.batch_flush_us,
+                "admission_inflight": self.admission_inflight,
+                "admission_backlog": self.admission_backlog,
                 "verifier": self.verifier,
                 "secure": self.secure,
                 "replicas": [dataclasses.asdict(r) for r in self.replicas],
@@ -99,6 +113,8 @@ class ClusterConfig:
             verify_flush_items=d.get("verify_flush_items", 0),
             batch_max_items=d.get("batch_max_items", 1),
             batch_flush_us=d.get("batch_flush_us", 0),
+            admission_inflight=d.get("admission_inflight", 0),
+            admission_backlog=d.get("admission_backlog", 0),
             verifier=d.get("verifier", "cpu"),
             secure=bool(d.get("secure", False)),
         )

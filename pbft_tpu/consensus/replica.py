@@ -71,6 +71,16 @@ class Reply:
 
 Action = object  # Send | Broadcast | Reply
 
+# Forwarded-request retention bound (ISSUE 12, mirrors core/replica.h
+# kMaxForwardedRetained; constants lint): a backup remembers the last
+# request it forwarded per client so a view change can RE-AIM it at the
+# new primary — without this, a request forwarded to a primary that then
+# gets voted out evaporates with the old view, and the only recovery is
+# the client's (slow) retransmission timer, during which the request
+# timers keep escalating view changes with nothing to order. On overflow
+# the map clears: retransmission covers the forgotten entries.
+MAX_FORWARDED_RETAINED = 1024
+
 
 _HOST_SIGNER = None
 
@@ -196,7 +206,17 @@ class Replica:
         self.in_view_change = False
         self.pending_view = 0
         self.view_changes: Dict[int, Dict[int, ViewChange]] = {}
-        self.new_view_sent: Set[int] = set()
+        # NEW-VIEW messages this replica (as primary-elect) has already
+        # built, keyed by view (ISSUE 12): membership suppresses redundant
+        # recomputation when retransmitted VIEW-CHANGEs arrive, and the
+        # cached message is RESENT point-to-point to a replica whose
+        # VIEW-CHANGE shows it never received the broadcast — lost-frame
+        # recovery without a second O computation or a second broadcast.
+        self.new_view_sent: Dict[int, NewView] = {}
+        # Our own latest VIEW-CHANGE (pending view): the runtime's
+        # retransmission timer re-broadcasts it verbatim instead of
+        # escalating on every expiry (ISSUE 12, §4.5 liveness under loss).
+        self._my_view_change: Optional[ViewChange] = None
         # (message, optional precomputed signable digest) — see receive().
         self._inbox: List[Tuple[Message, Optional[bytes]]] = []
         # Consensus-phase observer (utils.metrics.ConsensusSpans.on_phase):
@@ -223,6 +243,10 @@ class Replica:
         # also sees requests that sit in the unsealed batch.
         self._open_batch: List[ClientRequest] = []
         self._open_batch_ts: Dict[str, int] = {}
+        # Last request forwarded to the primary, per client (backup role;
+        # ISSUE 12): re-aimed at the new primary on view entry, retired
+        # at execution. Bounded by MAX_FORWARDED_RETAINED.
+        self._forwarded: Dict[str, ClientRequest] = {}
         # Highest timestamp per client this primary has SEALED under a
         # sequence number in the CURRENT view (PBFT §4.2: "the primary
         # checks its log" — without this, a client retransmission arriving
@@ -284,13 +308,26 @@ class Replica:
         if cached is not None and cached.timestamp == req.timestamp:
             self.counters["duplicate_requests"] += 1
             return [Reply(req.client, cached)]
-        if not self.is_primary:
-            # Forward to the primary (reference TODO src/client_handler.rs:66-68).
-            return [Send(self.primary, req)]
+        # A timestamp at or below the client's last EXECUTED one can
+        # never execute again (per-client exactly-once) and its reply is
+        # no longer cached: drop it on EVERY role (ISSUE 12). Backups
+        # used to forward these forever — each forward re-armed the
+        # request timer for a request with nothing left to order, and a
+        # client stuck retransmitting a superseded timestamp could drive
+        # perpetual view changes out of pure duplicate traffic.
         last = self.last_timestamp.get(req.client)
         if last is not None and req.timestamp <= last:
             self.counters["duplicate_requests"] += 1
             return []
+        if not self.is_primary:
+            # Forward to the primary (reference TODO src/client_handler.rs:66-68),
+            # and REMEMBER the request: if this view dies before it
+            # executes, _enter_new_view re-aims it at the new primary
+            # (ISSUE 12 — see MAX_FORWARDED_RETAINED).
+            if len(self._forwarded) >= MAX_FORWARDED_RETAINED:
+                self._forwarded.clear()
+            self._forwarded[req.client] = req
+            return [Send(self.primary, req)]
         # Duplicate suppression must also see the OPEN batch: a
         # retransmission arriving while its first copy waits unsealed
         # would otherwise be ordered (and executed) twice... well, once —
@@ -608,6 +645,7 @@ class Replica:
                     digest_size=32,
                 ).digest()
                 self.last_timestamp[req.client] = req.timestamp
+                self._forwarded.pop(req.client, None)  # executed: retire
                 reply = self._sign(
                     ClientReply(
                         view=view,
@@ -790,9 +828,23 @@ class Replica:
                 replica=self.id,
             )
         )
+        self._my_view_change = vc
         out: List[Action] = [Broadcast(vc)]
         out.extend(self._on_view_change(vc))  # log our own
         return out
+
+    def retransmit_view_change(self) -> List[Action]:
+        """Re-broadcast the VIEW-CHANGE for the pending view, verbatim
+        (runtime retransmission timer, ISSUE 12): under link loss the
+        original may never have reached the primary-elect — resending the
+        SAME signed message converges in the SAME view, where escalating
+        would burn a view number per lost frame. No counters move and
+        nothing is re-signed; receivers treat it as the duplicate it is
+        (and a primary-elect that already sent NEW-VIEW answers it with
+        the cached NEW-VIEW, see _on_view_change)."""
+        if not self.in_view_change or self._my_view_change is None:
+            return []
+        return [Broadcast(self._my_view_change)]
 
     def _prepared_proofs(self) -> List[dict]:
         """P: for each sequence prepared above the low watermark, the
@@ -862,6 +914,19 @@ class Replica:
 
     def _on_view_change(self, vc: ViewChange) -> List[Action]:
         if vc.new_view <= self.view:
+            # A VIEW-CHANGE for a view we already lead means the sender
+            # never received our NEW-VIEW (it was lost, or the sender is
+            # retransmitting on its timer): resend the cached message
+            # point-to-point — no recomputation, no re-broadcast
+            # (ISSUE 12 NEW-VIEW retransmission/suppression).
+            if (
+                vc.new_view == self.view
+                and self.config.primary_of(vc.new_view) == self.id
+                and vc.new_view in self.new_view_sent
+                and 0 <= vc.replica < self.config.n
+                and vc.replica != self.id
+            ):
+                return [Send(vc.replica, self.new_view_sent[vc.new_view])]
             return []
         slot = self.view_changes.setdefault(vc.new_view, {})
         if vc.replica in slot:
@@ -1003,7 +1068,7 @@ class Replica:
                 replica=self.id,
             )
         )
-        self.new_view_sent.add(v)
+        self.new_view_sent[v] = nv
         out: List[Action] = [Broadcast(nv)]
         out.extend(
             self._enter_new_view(v, min_s, self._stable_cert_for(vcs, min_s), pps)
@@ -1064,6 +1129,13 @@ class Replica:
         self.view = v
         self.in_view_change = False
         self.pending_view = 0
+        self._my_view_change = None
+        # Keep only the NEW-VIEW for the view we just entered (the one a
+        # laggard's retransmitted VIEW-CHANGE may still need); older
+        # entries can never be asked for again.
+        self.new_view_sent = {
+            w: m for w, m in self.new_view_sent.items() if w >= v
+        }
         self._sealed_ts = {}  # per-view primary ordering memory
         self.counters["view_changes_completed"] += 1
         vh = self.view_hook
@@ -1096,6 +1168,23 @@ class Replica:
                 del log[key]
         for pp in pps:
             out.extend(self._on_pre_prepare(pp))
+        # Re-aim forwarded-but-unexecuted client requests at the NEW
+        # primary (ISSUE 12): a request forwarded to a primary that was
+        # just voted out evaporated with the old view — without this the
+        # only recovery is the client's retransmission timer, and until
+        # it fires the request timers keep escalating further view
+        # changes with nothing to order (the storm the chaos bench
+        # measures). Exactly-once is untouched: duplicates die on the
+        # per-client timestamp guards wherever they land.
+        for client, req in list(self._forwarded.items()):
+            last = self.last_timestamp.get(client)
+            if last is not None and req.timestamp <= last:
+                self._forwarded.pop(client, None)  # already executed
+                continue
+            if self.config.primary_of(v) == self.id:
+                out.extend(self.on_client_request(req))
+            else:
+                out.append(Send(self.config.primary_of(v), req))
         return out
 
     def _advance_watermark(

@@ -213,6 +213,32 @@ class PbftClient:
                 r for r in self.replies if r.get("timestamp", 0) > timestamp
             ]
 
+    # Overload rejections absorbed across every request_with_retry call
+    # (ISSUE 12): explicit {"type": "overloaded"} replies, NOT timeouts.
+    overload_rejections = 0
+
+    def _consume_overloaded(self, timestamp: int) -> int:
+        """Remove and count explicit overload rejections for
+        ``timestamp`` from the reply stream (they never carry a
+        signature, so the quorum count can't see them)."""
+        with self._lock:
+            hits = sum(
+                1
+                for r in self.replies
+                if r.get("type") == "overloaded"
+                and r.get("timestamp") == timestamp
+            )
+            if hits:
+                self.replies = [
+                    r
+                    for r in self.replies
+                    if not (
+                        r.get("type") == "overloaded"
+                        and r.get("timestamp") == timestamp
+                    )
+                ]
+        return hits
+
     def request_with_retry(
         self,
         operation: str,
@@ -226,7 +252,15 @@ class PbftClient:
         forwards + eventually a view change on a faulty primary), with
         jittered exponential backoff between retries so a thundering herd
         of retrying clients de-synchronizes instead of beating the
-        cluster in lockstep."""
+        cluster in lockstep.
+
+        An explicit ``overloaded`` rejection (ISSUE 12 admission control)
+        is handled DISTINCTLY from a timeout: the cluster is alive and
+        told us to slow down, so the client backs off (with jitter)
+        WITHOUT rotating targets or broadcasting — a rotating storm of
+        rejected retries is exactly the thundering herd admission control
+        exists to shed. Rejections are tallied in ``overload_rejections``
+        and in the request's latency record (client trace)."""
         import random as _random
         import time as _time
 
@@ -247,6 +281,7 @@ class PbftClient:
         send_to(0)
         deadline = _time.monotonic() + timeout
         attempt = 0
+        target = 0
         rng = _random.Random()
         while True:
             # Jittered exponential backoff, capped: base * 1.5^attempt,
@@ -257,13 +292,28 @@ class PbftClient:
             try:
                 return self.wait_result(ts, timeout=wait)
             except TimeoutError:
+                rejected = self._consume_overloaded(ts)
                 if _time.monotonic() >= deadline:
                     raise
                 attempt += 1
+                if rejected:
+                    # Admission-control rejection: back off in place. The
+                    # SAME target re-admits us once its backlog drains —
+                    # rotating or broadcasting would multiply the load
+                    # n-fold exactly when the cluster asked for less.
+                    self.overload_rejections += rejected
+                    rec = self.latency_log.get(ts)
+                    if rec is not None:
+                        rec["overloaded"] = (
+                            rec.get("overloaded", 0) + rejected
+                        )
+                    send_to(target)
+                    continue
                 # Rotate the direct target across replicas, then broadcast
                 # (the §4.1 rule) — the rotation guarantees some honest
                 # replica hears us even when specific links are dead.
-                send_to(attempt % self.config.n)
+                target = attempt % self.config.n
+                send_to(target)
                 for rid in range(self.config.n):
                     send_to(rid)
 
@@ -278,7 +328,7 @@ class PbftClient:
             if "send" not in rec:
                 continue
             row = {"client": self.address, "req_ts": ts, "send": rec["send"]}
-            for k in ("first_reply", "quorum"):
+            for k in ("first_reply", "quorum", "overloaded"):
                 if k in rec:
                     row[k] = rec[k]
             out.append(row)
@@ -300,6 +350,10 @@ class PbftClient:
                     for k in ("first_reply", "quorum")
                     if k in row
                 }
+                if "overloaded" in row:
+                    # Admission-control rejections absorbed (ISSUE 12):
+                    # an integer count, not a monotonic stamp.
+                    extra["overloaded"] = int(row["overloaded"])
                 tracer.event(
                     "client_request",
                     client=row["client"],

@@ -106,6 +106,9 @@ class ClientGateway:
         host: str = "0.0.0.0",
         port: int = 0,
         metrics_port: Optional[int] = None,
+        max_inflight: int = 0,
+        max_queue_depth: int = 0,
+        flight=None,
     ):
         if config.secure:
             raise ValueError(
@@ -143,6 +146,27 @@ class ClientGateway:
         self.forwarded = 0
         self.replies_routed = 0
         self.backpressure_events = 0
+        # Admission control (ISSUE 12): per-token in-flight cap +
+        # a global queue-depth watermark. A FRESH request past either
+        # bound is answered with an explicit {"type": "overloaded"} line
+        # downstream and NOT forwarded; retransmissions of an already
+        # in-flight (token, ts) always pass — liveness is never
+        # admission-gated. In-flight entries prune when a reply routes
+        # (per-client execution is timestamp-ordered, so a reply for ts
+        # retires every entry at or below it). 0 disables either bound.
+        self.max_inflight = max_inflight
+        self.max_queue_depth = max_queue_depth
+        self._inflight: Dict[str, set] = {}
+        self._inflight_total = 0
+        self.overload_rejections = 0
+        # Gateway-fabric failovers (ISSUE 12): upstream replica links this
+        # gateway had to re-dial after they died mid-run.
+        self.upstream_failovers = 0
+        # Black-box flight recorder (utils/flight.py, --flight-file):
+        # failover/overload events ship with the chaos bench's black
+        # boxes the same way replica recorders do. None = one attribute
+        # check per event site.
+        self.flight = flight
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -197,6 +221,9 @@ class ClientGateway:
             "replies_routed": self.replies_routed,
             "backpressure_events": self.backpressure_events,
             "upstream_links": len(self._links),
+            "overload_rejections": self.overload_rejections,
+            "gateway_failovers": self.upstream_failovers,
+            "inflight": self._inflight_total,
             "view": self._view,
         }
 
@@ -280,13 +307,31 @@ class ClientGateway:
             self._routes.clear()
         self._routes[token] = writer
         ts = obj.get("timestamp")
+        retransmission = (
+            isinstance(ts, int) and self._last_ts.get(token, -1) >= ts
+        )
+        if not retransmission and isinstance(ts, int):
+            # Admission control (ISSUE 12): a fresh request past the
+            # per-token in-flight cap or the global watermark is rejected
+            # with an explicit overloaded line instead of queueing into
+            # the cluster's tail. Retransmissions always pass.
+            pend = self._inflight.setdefault(token, set())
+            if ts not in pend and (
+                (self.max_inflight > 0 and len(pend) >= self.max_inflight)
+                or (
+                    self.max_queue_depth > 0
+                    and self._inflight_total >= self.max_queue_depth
+                )
+            ):
+                self._reject_overloaded(token, ts, writer)
+                return
+            if ts not in pend:
+                pend.add(ts)
+                self._inflight_total += 1
         framed = _frame_bytes(bytes(line))
         self.forwarded += 1
         if self.metrics_registry.enabled:
             self.metrics_registry.counter("pbft_gateway_forwarded_total").inc()
-        retransmission = (
-            isinstance(ts, int) and self._last_ts.get(token, -1) >= ts
-        )
         if isinstance(ts, int) and not retransmission:
             if len(self._last_ts) >= _MAX_TOKENS:
                 self._last_ts.clear()
@@ -299,6 +344,49 @@ class ClientGateway:
                 await self._send_upstream(rid, framed)
         else:
             await self._send_upstream(self._view % self.config.n, framed)
+
+    def _reject_overloaded(self, token: str, ts: int, writer) -> None:
+        """Answer a rejected request with an explicit overloaded line —
+        the client backs off with jitter (request_with_retry) instead of
+        interpreting silence as a faulty primary."""
+        self.overload_rejections += 1
+        if self.metrics_registry.enabled:
+            self.metrics_registry.counter(
+                "pbft_overload_rejections_total"
+            ).inc()
+        if self.flight is not None:
+            self.flight.record("overload_rejected", view=self._view, seq=ts)
+        if writer.is_closing() or not self._writer_has_room(writer):
+            return
+        try:
+            writer.write(
+                json.dumps(
+                    {
+                        "type": "overloaded",
+                        "client": token,
+                        "timestamp": ts,
+                        "replica": -1,
+                    },
+                    separators=(",", ":"),
+                ).encode()
+                + b"\n"
+            )
+        except (ConnectionError, OSError, RuntimeError):
+            pass
+
+    def _retire_inflight(self, token: str, ts: int) -> None:
+        """A reply for (token, ts) routed downstream: per-client execution
+        is timestamp-ordered, so every in-flight entry at or below ts is
+        complete (or superseded) — prune them all."""
+        pend = self._inflight.get(token)
+        if not pend:
+            return
+        done = {t for t in pend if t <= ts}
+        if done:
+            pend.difference_update(done)
+            self._inflight_total -= len(done)
+        if not pend:
+            del self._inflight[token]
 
     # -- upstream (replicas) -------------------------------------------------
 
@@ -391,6 +479,19 @@ class ClientGateway:
             link = self._links.get(rid)
             if link is not None and link.task is asyncio.current_task():
                 self._links.pop(rid, None)
+            if not self._stopping:
+                # Upstream replica link died mid-run (ISSUE 12): the
+                # keeper re-dials within a second — count the failover so
+                # a chaos arm can attribute the blip.
+                self.upstream_failovers += 1
+                if self.metrics_registry.enabled:
+                    self.metrics_registry.counter(
+                        "pbft_gateway_failovers_total"
+                    ).inc()
+                if self.flight is not None:
+                    self.flight.record(
+                        "gateway_failover", view=self._view, peer=rid
+                    )
 
     def _route_reply(self, obj: dict, payload: bytes) -> None:
         token = obj.get("client")
@@ -399,6 +500,11 @@ class ClientGateway:
         view = obj.get("view")
         if isinstance(view, int) and view > self._view:
             self._view = view  # a view change re-aims fresh requests
+        ts = obj.get("timestamp")
+        if isinstance(ts, int):
+            # Completion retires admission bookkeeping whether or not the
+            # downstream client is still connected to hear about it.
+            self._retire_inflight(token, ts)
         w = self._routes.get(token)
         if w is None or w.is_closing():
             return  # token not ours (fan-out copy) or client gone
@@ -434,12 +540,21 @@ class GatewayClient(PbftClient):
     """PbftClient surface over a gateway connection: same f+1
     signature-verified reply quorum (wait_result is inherited), but no
     dial-back listener — requests and replies share ONE socket, and the
-    identity is a routing token instead of host:port."""
+    identity is a routing token instead of host:port.
+
+    HA (ISSUE 12): pass SEVERAL gateway addresses and the client fails
+    over on a dead socket — reconnect to the next gateway, same stable
+    ``gw/`` token, and replay of the in-flight request lines. Because the
+    token and timestamps are unchanged, the replicas' per-(client, ts)
+    exactly-once guard + reply caches make the replay safe: a request the
+    dead gateway already forwarded executes once and the replay is
+    answered from the cache, one it never forwarded gets ordered now —
+    completion stays 100% through a gateway death mid-request."""
 
     def __init__(
         self,
         config: ClusterConfig,
-        gateway_addr: str,
+        gateway_addr,
         token: Optional[str] = None,
     ):
         # Deliberately no super().__init__: the base class would start a
@@ -452,15 +567,70 @@ class GatewayClient(PbftClient):
         self._timestamp = 0
         self.latency_log = {}
         self.address = token or next_token()
-        host, _, port = gateway_addr.rpartition(":")
-        self.sock = socket.create_connection((host, int(port)), timeout=10)
-        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        self._rx_thread = threading.Thread(target=self._read_loop, daemon=True)
+        self._addrs: List[str] = (
+            [gateway_addr]
+            if isinstance(gateway_addr, str)
+            else list(gateway_addr)
+        )
+        self._addr_idx = 0
+        # ts -> raw request line, for the failover replay. Entries retire
+        # on the first reply seen for their timestamp (a partially-voted
+        # request is re-covered by the normal retransmission path).
+        self._inflight_lines: Dict[int, bytes] = {}
+        self.failovers = 0
+        self._closed = False
+        self.sock = self._dial_gateway(first=True)
+        self._rx_thread = threading.Thread(
+            target=self._read_loop, args=(self.sock,), daemon=True
+        )
         self._rx_thread.start()
 
-    def _read_loop(self) -> None:
+    def _dial_gateway(self, first: bool = False) -> socket.socket:
+        """Dial gateways round-robin starting at the current index;
+        raises the last OSError when none answers."""
+        last_err: Optional[OSError] = None
+        for i in range(len(self._addrs)):
+            idx = (self._addr_idx + (0 if first else 1) + i) % len(
+                self._addrs
+            )
+            host, _, port = self._addrs[idx].rpartition(":")
+            try:
+                s = socket.create_connection((host, int(port)), timeout=10)
+            except OSError as e:
+                last_err = e
+                continue
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._addr_idx = idx
+            return s
+        raise last_err or OSError("no gateway reachable")
+
+    def _failover_locked(self, dead: socket.socket) -> None:
+        """Replace a dead gateway socket (caller holds _send_lock): dial
+        the next gateway, replay every in-flight request line under the
+        SAME token, restart the reader. Raises OSError when no gateway
+        answers (callers surface it or retry on their own timer)."""
+        if self._closed or self.sock is not dead:
+            return  # another thread already failed over
         try:
-            fh = self.sock.makefile("rb")
+            dead.close()
+        except OSError:
+            pass
+        s = self._dial_gateway()
+        self.sock = s
+        self.failovers += 1
+        for ts in sorted(self._inflight_lines):
+            try:
+                s.sendall(self._inflight_lines[ts])
+            except OSError:
+                break  # the next _send_line attempt fails over again
+        self._rx_thread = threading.Thread(
+            target=self._read_loop, args=(s,), daemon=True
+        )
+        self._rx_thread.start()
+
+    def _read_loop(self, sock: socket.socket) -> None:
+        try:
+            fh = sock.makefile("rb")
             for line in fh:
                 rx = time.monotonic()
                 line = line.strip()
@@ -472,13 +642,29 @@ class GatewayClient(PbftClient):
                     continue
                 if isinstance(reply, dict):
                     reply["_rx"] = rx
+                    ts = reply.get("timestamp")
+                    if (
+                        isinstance(ts, int)
+                        and reply.get("type") != "overloaded"
+                    ):
+                        self._inflight_lines.pop(ts, None)
                     with self._new_reply:
                         self.replies.append(reply)
                         self._new_reply.notify_all()
         except (OSError, ValueError):
             pass  # socket closed
+        # EOF/error on the CURRENT socket = the gateway died under us:
+        # fail over proactively so queued replies keep flowing even
+        # before the next send notices.
+        if not self._closed:
+            with self._send_lock:
+                try:
+                    self._failover_locked(sock)
+                except OSError:
+                    pass  # no gateway up right now; sends will retry
 
     def close(self) -> None:
+        self._closed = True
         try:
             self.sock.shutdown(socket.SHUT_RDWR)
         except OSError:
@@ -487,7 +673,13 @@ class GatewayClient(PbftClient):
 
     def _send_line(self, payload: bytes) -> None:
         with self._send_lock:  # not _lock: sendall must never block the
-            self.sock.sendall(payload)  # reply-reader thread's notify
+            for _ in range(1 + len(self._addrs)):  # reply reader's notify
+                sock = self.sock
+                try:
+                    sock.sendall(payload)
+                    return
+                except OSError:
+                    self._failover_locked(sock)  # raises when none answer
 
     def request(self, operation, to_replica=0, timestamp=None):
         """One raw-JSON request through the gateway (the gateway picks
@@ -501,7 +693,9 @@ class GatewayClient(PbftClient):
             operation=operation, timestamp=timestamp, client=self.address
         )
         self._stamp_send(timestamp)
-        self._send_line(req.canonical() + b"\n")
+        line = req.canonical() + b"\n"
+        self._inflight_lines[timestamp] = line
+        self._send_line(line)
         return req
 
     def request_many(self, operations, to_replica=0, window=32, timeout=30.0):
@@ -524,7 +718,9 @@ class GatewayClient(PbftClient):
                     client=self.address,
                 )
                 self._stamp_send(ts)
-                self._send_line(req.canonical() + b"\n")
+                line = req.canonical() + b"\n"
+                self._inflight_lines[ts] = line
+                self._send_line(line)
                 timestamps.append(ts)
                 inflight.append((ts, operations[next_op]))
                 next_op += 1
@@ -536,22 +732,28 @@ class GatewayClient(PbftClient):
                 retry = ClientRequest(
                     operation=op, timestamp=ts, client=self.address
                 )
-                self._send_line(retry.canonical() + b"\n")
+                line = retry.canonical() + b"\n"
+                self._inflight_lines[ts] = line
+                self._send_line(line)
                 results[ts] = self.wait_result(ts, timeout=timeout)
                 self._drop_replies_upto(ts)
+            self._inflight_lines.pop(ts, None)
         return [results[ts] for ts in timestamps]
 
 
 # -- daemon entry -------------------------------------------------------------
 
 
-async def _amain(args, config_text: str) -> None:
+async def _amain(args, config_text: str, flight=None) -> None:
     config = ClusterConfig.from_json(config_text)
     gw = ClientGateway(
         config,
         host=args.host,
         port=args.port,
         metrics_port=args.metrics_port,
+        max_inflight=args.max_inflight,
+        max_queue_depth=args.max_queue_depth,
+        flight=flight,
     )
     await gw.start()
     print(f"gateway listening on {gw.listen_port}", flush=True)
@@ -573,10 +775,43 @@ def main() -> None:
         default=None,
         help="serve Prometheus text format on this port (0 = ephemeral)",
     )
+    parser.add_argument(
+        "--max-inflight",
+        type=int,
+        default=0,
+        help="admission control (ISSUE 12): per-client-token in-flight "
+        "request cap — a fresh request past it is answered with an "
+        "explicit overloaded line instead of forwarded (0 = off)",
+    )
+    parser.add_argument(
+        "--max-queue-depth",
+        type=int,
+        default=0,
+        help="admission control: global in-flight watermark across every "
+        "token this gateway forwards for (0 = off)",
+    )
+    parser.add_argument(
+        "--flight-file",
+        default=None,
+        help="black-box flight recorder dump target (failover/overload "
+        "events), written on SIGTERM/SIGINT — decode with "
+        "scripts/flight_dump.py",
+    )
     args = parser.parse_args()
+    flight = None
+    if args.flight_file:
+        from ..utils.flight import FlightRecorder, install_signal_dump
+
+        flight = FlightRecorder(capacity=8192)
+        install_signal_dump(flight, args.flight_file)
     with open(args.config) as fh:
         config_text = fh.read()
-    asyncio.run(_amain(args, config_text))
+    try:
+        asyncio.run(_amain(args, config_text, flight=flight))
+    except BaseException:
+        if flight is not None:
+            flight.dump(args.flight_file)
+        raise
 
 
 if __name__ == "__main__":

@@ -69,6 +69,8 @@ class LocalCluster:
         chaos_drop_pct: float = 0.0,
         chaos_delay_ms: int = 0,
         chaos_seed: Optional[int] = None,
+        admission_inflight: int = 0,
+        admission_backlog: int = 0,
     ):
         self.trace_dir = trace_dir
         # Black-box flight recorders (ISSUE 9): each daemon dumps its last
@@ -129,6 +131,10 @@ class LocalCluster:
                 batch_flush_us=(
                     batch_flush_us if self._batch_scalar else 0
                 ),
+                # Admission control (ISSUE 12): network.json knobs, read
+                # identically by both runtimes.
+                admission_inflight=admission_inflight,
+                admission_backlog=admission_backlog,
             )
         self.config = config
         self.seeds = seeds

@@ -141,6 +141,62 @@ MAX_CONN_OUTBOUND = 8 << 20
 MAX_GATEWAY_ROUTES = 1 << 17
 
 
+class ViewTimerBackoff:
+    """Pure §4.5.2 view-timer policy (ISSUE 12), shared semantics with
+    core/net.cc check_progress_timer and unit-tested in
+    tests/test_view_change.py. The runtime polls it with the current
+    clock and progress markers; the policy answers what to do:
+
+      "armed"      a fresh deadline was set (timeout_s x level)
+      "idle"       deadline not reached yet
+      "progress"   work advanced since arming — level resets to 1
+      "retransmit" deadline expired mid-view-change, first expiry at this
+                   level: re-broadcast the pending VIEW-CHANGE verbatim
+                   (lost-frame recovery converges in the SAME view)
+      "escalate"   deadline expired with no progress (again): start the
+                   next view change; the level doubles (T, 2T, 4T, ...,
+                   capped) so cascading view changes decelerate instead
+                   of storming.
+    """
+
+    MAX_LEVEL = 64  # cap: 64 x T between escalations at the extreme
+
+    def __init__(self, timeout_s: float):
+        self.timeout_s = timeout_s
+        self.level = 1
+        self.deadline: Optional[float] = None
+        self._snapshot = (0, 0)  # (executed_upto, view) at arm time
+        self._retransmitted = False
+
+    def clear(self) -> None:
+        """No pending work: disarm and reset the backoff."""
+        self.deadline = None
+        self.level = 1
+        self._retransmitted = False
+
+    def poll(
+        self, now: float, executed: int, view: int, in_view_change: bool
+    ) -> str:
+        if self.deadline is None:
+            self._snapshot = (executed, view)
+            self.deadline = now + self.timeout_s * self.level
+            return "armed"
+        if now < self.deadline:
+            return "idle"
+        self.deadline = None  # rearmed by the next poll while work pends
+        exec_snap, view_snap = self._snapshot
+        if executed > exec_snap or view > view_snap:
+            self.level = 1
+            self._retransmitted = False
+            return "progress"
+        if in_view_change and not self._retransmitted:
+            self._retransmitted = True
+            return "retransmit"
+        self.level = min(self.level * 2, self.MAX_LEVEL)
+        self._retransmitted = False
+        return "escalate"
+
+
 async def _read_frame(reader, timeout: float = 10.0) -> bytes:
     hdr = await asyncio.wait_for(reader.readexactly(4), timeout)
     n = int.from_bytes(hdr, "big")
@@ -326,12 +382,23 @@ class AsyncReplicaServer:
         self._reply_dial_sem = asyncio.Semaphore(32)
         self._reply_addr_locks: Dict[str, asyncio.Lock] = {}
         self._reply_addr_refs: Dict[str, int] = {}
-        # Progress timer state (mirrors core/net.cc check_progress_timer).
+        # Progress timer state (mirrors core/net.cc check_progress_timer):
+        # the ViewTimerBackoff policy decides retransmit-vs-escalate and
+        # the exponential level (ISSUE 12, §4.5.2).
         self._waiting_requests: Dict[Tuple[str, int], float] = {}
-        self._timer_deadline: Optional[float] = None
         self._state_retry_deadline: Optional[float] = None
-        self._timer_snapshot = (0, 0)  # (executed_upto, view)
-        self._timer_backoff = 1
+        self._vc_policy = ViewTimerBackoff(vc_timeout)
+        self._gauged_backoff = 1  # last backoff level pushed to the gauge
+        # Admission control (ISSUE 12): explicit overload rejections
+        # instead of silent queueing — config.admission_inflight caps a
+        # client's estimated in-flight requests (timestamp distance past
+        # its last executed one), config.admission_backlog watermarks the
+        # replica's own backlog (verify inbox + sealed-but-unexecuted
+        # sequences). 0 disables either check.
+        self.overload_rejections = 0
+        # Gateway-fabric accounting (ISSUE 12): live gateway links that
+        # died (clients behind them must fail over to another gateway).
+        self.gateway_failovers = 0
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -614,6 +681,21 @@ class AsyncReplicaServer:
         finally:
             if gw_link_id is not None:
                 self._gateway_links.pop(gw_link_id, None)
+                if not self._stopping:
+                    # A live gateway link died (ISSUE 12): clients behind
+                    # it must fail over to another gateway — count it so
+                    # the chaos bench can attribute the blip.
+                    self.gateway_failovers += 1
+                    if self.metrics_registry.enabled:
+                        self.metrics_registry.counter(
+                            "pbft_gateway_failovers_total"
+                        ).inc()
+                    if self.flight is not None:
+                        self.flight.record(
+                            "gateway_failover",
+                            view=self.replica.view,
+                            peer=gw_link_id & 0x7FFF,
+                        )
 
     def _note_gateway_route(self, client: str, link_id: int) -> None:
         """Bounded route cache (mirrors core/net.cc note_gateway_route):
@@ -635,11 +717,67 @@ class AsyncReplicaServer:
         else:
             tracer.event("new_view_installed", replica=self.id, view=v)
 
+    def _admission_reject(self, req: ClientRequest) -> bool:
+        """Admission control at request ingest (ISSUE 12): a FRESH request
+        past the per-client in-flight cap or the global backlog watermark
+        is answered with an explicit {"type": "overloaded"} line (over the
+        gateway link or the dial-back channel) and dropped — the client
+        backs off with jitter instead of silently queueing into the p99.
+        Retransmissions (timestamp at or below the client's last executed
+        one) always pass: the reply cache answers them, and liveness must
+        never be admission-gated. Mirrors core/net.cc."""
+        cfg = self.config
+        if cfg.admission_inflight <= 0 and cfg.admission_backlog <= 0:
+            return False
+        last = self.replica.last_timestamp.get(req.client, 0)
+        if req.timestamp <= last:
+            return False
+        reject = (
+            cfg.admission_inflight > 0
+            and req.timestamp - last > cfg.admission_inflight
+        )
+        if not reject and cfg.admission_backlog > 0:
+            backlog = self.replica.pending_count() + max(
+                0, self.replica.seq_counter - self.replica.executed_upto
+            )
+            reject = backlog > cfg.admission_backlog
+        if not reject:
+            return False
+        self.overload_rejections += 1
+        if self.metrics_registry.enabled:
+            self.metrics_registry.counter(
+                "pbft_overload_rejections_total"
+            ).inc()
+        if self.flight is not None:
+            self.flight.record(
+                "overload_rejected",
+                view=self.replica.view,
+                seq=req.timestamp,
+            )
+        payload = json.dumps(
+            {
+                "type": "overloaded",
+                "client": req.client,
+                "timestamp": req.timestamp,
+                "replica": self.id,
+            },
+            separators=(",", ":"),
+        ).encode()
+        if req.client.startswith(GATEWAY_CLIENT_PREFIX):
+            self._gateway_line(req.client, payload)
+        else:
+            asyncio.get_running_loop().create_task(
+                self._dial_line(req.client, payload + b"\n")
+            )
+        return True
+
     def _ingest(self, msg: Message, payload: Optional[bytes] = None) -> None:
         self.frames_in += 1
         if self.metrics_registry.enabled:
             self.metrics_registry.counter("pbft_frames_in_total").inc()
         if isinstance(msg, ClientRequest):
+            if self._admission_reject(msg):
+                return
             # Request-level waterfall anchor (ISSUE 9): when this replica
             # first saw the request — on the primary, the start of the
             # client-queue -> batch-wait handoff.
@@ -1109,13 +1247,17 @@ class AsyncReplicaServer:
                 self._peer_links.pop(dest, None)
 
     def _gateway_reply(self, client: str, reply: ClientReply) -> None:
-        """Fan a reply back over the gateway link that forwarded for
-        ``client`` (exact route), or over EVERY live gateway link when the
-        route is unknown/stale — gateways drop tokens they don't own, so
-        degradation is extra frames, never a lost reply quorum. Writes are
-        admission-checked (bounded outbound) and never awaited: a slow
-        gateway costs dropped replies, not a stalled replica."""
-        payload = _frame_bytes(reply.canonical())
+        self._gateway_line(client, reply.canonical())
+
+    def _gateway_line(self, client: str, line: bytes) -> None:
+        """Fan a raw-JSON line (reply or overloaded notice) back over the
+        gateway link that forwarded for ``client`` (exact route), or over
+        EVERY live gateway link when the route is unknown/stale —
+        gateways drop tokens they don't own, so degradation is extra
+        frames, never a lost reply quorum. Writes are admission-checked
+        (bounded outbound) and never awaited: a slow gateway costs
+        dropped replies, not a stalled replica."""
+        payload = _frame_bytes(line)
         wid = self._gateway_routes.get(client)
         if wid is not None and wid in self._gateway_links:
             writers = [self._gateway_links[wid]]
@@ -1132,6 +1274,10 @@ class AsyncReplicaServer:
                 pass
 
     async def _dial_reply(self, client_addr: str, reply: ClientReply) -> None:
+        reply = self._corrupt_sig(reply)
+        await self._dial_line(client_addr, reply.canonical() + b"\n")
+
+    async def _dial_line(self, client_addr: str, line: bytes) -> None:
         # One dial per address at a time — a LATER reply to the same
         # address is a distinct message (the client may already be on its
         # next request), so queue on the address lock (FIFO) rather than
@@ -1153,13 +1299,12 @@ class AsyncReplicaServer:
                         # stale is the retransmission path's job now.
                         return
                     host, _, port = client_addr.rpartition(":")
-                    reply = self._corrupt_sig(reply)
                     try:
                         _, writer = await asyncio.wait_for(
                             asyncio.open_connection(host, int(port)),
                             timeout=3.0,
                         )
-                        writer.write(reply.canonical() + b"\n")
+                        writer.write(line)
                         await asyncio.wait_for(writer.drain(), timeout=3.0)
                         writer.close()
                     except (OSError, ValueError, asyncio.TimeoutError):
@@ -1198,23 +1343,35 @@ class AsyncReplicaServer:
             self._state_retry_deadline = None
             pending = bool(self._waiting_requests) or self.replica.has_unexecuted()
             if not pending:
-                self._timer_deadline = None
-                self._timer_backoff = 1
+                self._vc_policy.clear()
+                self._observe_backoff_level()
                 continue
-            if self._timer_deadline is None:
-                self._timer_snapshot = (self.replica.executed_upto, self.replica.view)
-                self._timer_deadline = now + self.vc_timeout * self._timer_backoff
-                continue
-            if now < self._timer_deadline:
-                continue
-            exec_snap, view_snap = self._timer_snapshot
-            if (
-                self.replica.executed_upto > exec_snap
-                or self.replica.view > view_snap
-            ):
-                self._timer_backoff = 1
-            else:
-                self._timer_backoff = min(self._timer_backoff * 2, 64)
+            state = self._vc_policy.poll(
+                now,
+                self.replica.executed_upto,
+                self.replica.view,
+                self.replica.in_view_change,
+            )
+            if state == "retransmit":
+                # First no-progress expiry while a view change pends:
+                # re-broadcast the pending VIEW-CHANGE verbatim instead
+                # of escalating — a lost VIEW-CHANGE/NEW-VIEW recovers in
+                # the SAME view (ISSUE 12). The primary-elect answers a
+                # retransmitted VIEW-CHANGE with its cached NEW-VIEW.
+                if self.flight is not None:
+                    self.flight.record(
+                        "view_timer_fired",
+                        view=self.replica.view,
+                        seq=self._vc_policy.level,
+                    )
+                get_tracer().event(
+                    "view_timer_fired",
+                    replica=self.id,
+                    view=self.replica.view,
+                    backoff=self._vc_policy.level,
+                )
+                self._emit(self.replica.retransmit_view_change())
+            elif state == "escalate":
                 if self.metrics_registry.enabled:
                     self.metrics_registry.counter("pbft_view_changes_total").inc()
                 # The view-change span opens here (ROADMAP item 4):
@@ -1223,22 +1380,39 @@ class AsyncReplicaServer:
                     self.flight.record(
                         "view_timer_fired",
                         view=self.replica.view,
-                        seq=self._timer_backoff,
+                        seq=self._vc_policy.level,
                     )
                 get_tracer().event(
                     "view_timer_fired",
                     replica=self.id,
                     view=self.replica.view,
-                    backoff=self._timer_backoff,
+                    backoff=self._vc_policy.level,
                 )
                 get_tracer().event(
                     "view_change_start",
                     replica=self.id,
                     pending_view=self.replica.view + 1,
-                    backoff=self._timer_backoff,
+                    backoff=self._vc_policy.level,
                 )
                 self._emit(self.replica.start_view_change())
-            self._timer_deadline = None
+            self._observe_backoff_level()
+
+    def _observe_backoff_level(self) -> None:
+        """Push the view-timer backoff level to the gauge + flight
+        recorder when it changed (ISSUE 12): a sustained high level IS
+        the storm signal the chaos bench reads."""
+        level = self._vc_policy.level
+        if level == self._gauged_backoff:
+            return
+        self._gauged_backoff = level
+        if self.metrics_registry.enabled:
+            self.metrics_registry.gauge("pbft_view_timer_backoff_level").set(
+                level
+            )
+        if self.flight is not None:
+            self.flight.record(
+                "backoff_level", view=self.replica.view, seq=level
+            )
 
     def metrics(self) -> dict:
         return {
@@ -1267,6 +1441,10 @@ class AsyncReplicaServer:
             "backpressure_events": self.backpressure_events,
             "gateway_links": len(self._gateway_links),
             "gateway_forwarded": self.gateway_forwarded,
+            # Perf-under-faults surface (ISSUE 12).
+            "overload_rejections": self.overload_rejections,
+            "gateway_failovers": self.gateway_failovers,
+            "view_timer_backoff": self._vc_policy.level,
             "faults_injected": self.faults_injected,
             "chaos_dropped": self.chaos_dropped,
             "executed_upto": self.replica.executed_upto,

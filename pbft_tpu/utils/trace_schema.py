@@ -110,9 +110,11 @@ EVENT_SCHEMAS = {
     # Client-side half of the waterfall (net/client.py write_trace): send /
     # first-reply / f+1-quorum monotonic stamps per (client, req_ts).
     # Comparable to replica stamps on one host (CLOCK_MONOTONIC).
+    # "overloaded" counts explicit admission-control rejections the client
+    # absorbed for this request (ISSUE 12) — distinct from silent timeouts.
     "client_request": {
         "required": {"ts", "ev", "client", "req_ts", "send"},
-        "optional": {"first_reply", "quorum"},
+        "optional": {"first_reply", "quorum", "overloaded"},
         "emitters": {"client.py"},
     },
 }
@@ -212,6 +214,26 @@ METRIC_SCHEMAS = {
         "counter",
         {"gateway.py", "server.py", "net.cc"},
     ),
+    # Perf-under-faults surface (ISSUE 12). Backoff level: the view
+    # timer's current exponential multiplier (1 = fresh, doubles per
+    # consecutive no-progress expiry, §4.5.2) — a sustained high level is
+    # a cluster failing to converge. Overload rejections: client requests
+    # answered with an explicit {"type":"overloaded"} instead of being
+    # queued into the tail (admission control: per-client in-flight caps
+    # + the global backlog watermark; gateway and both replica runtimes).
+    # Gateway failovers: a gateway-fabric link had to be replaced — a
+    # client failing over to another gateway (GatewayClient), a gateway
+    # re-dialing a dead replica link (ClientGateway), or a replica losing
+    # a live gateway link (both runtimes).
+    "pbft_view_timer_backoff_level": ("gauge", {"server.py", "net.cc"}),
+    "pbft_overload_rejections_total": (
+        "counter",
+        {"gateway.py", "server.py", "net.cc"},
+    ),
+    "pbft_gateway_failovers_total": (
+        "counter",
+        {"gateway.py", "server.py", "net.cc"},
+    ),
     "pbft_batch_size": ("histogram", {"server.py", "net.cc"}),
     "pbft_verify_batch_size": ("histogram", {"server.py", "service.py", "net.cc"}),
     "pbft_verify_seconds": ("histogram", {"server.py", "service.py", "net.cc"}),
@@ -262,6 +284,14 @@ FLIGHT_EVENTS = {
     9: "view_change_sent",
     10: "new_view_installed",
     11: "verify_batch",
+    # Perf-under-faults coverage (ISSUE 12): the view timer's backoff
+    # level changed (seq = new level), a client request was answered with
+    # an explicit overload rejection (seq = request timestamp), and a
+    # gateway-fabric link was replaced (peer = replica id / gateway index
+    # where meaningful).
+    12: "backoff_level",
+    13: "overload_rejected",
+    14: "gateway_failover",
 }
 FLIGHT_EVENT_IDS = {name: i for i, name in FLIGHT_EVENTS.items()}
 

@@ -33,15 +33,16 @@ from pbft_tpu.net.launcher import LocalCluster
 REPO = Path(__file__).resolve().parent.parent
 
 
-def _start_gateway(cluster: LocalCluster):
+def _start_gateway(cluster: LocalCluster, name: str = "gateway", extra=()):
     """One gateway subprocess in front of ``cluster``; returns
-    (Popen, "host:port")."""
+    (Popen, "host:port"). ``name`` keys the log file so several gateways
+    can front one cluster; ``extra`` appends CLI flags (admission knobs)."""
     cfg = Path(cluster.tmpdir.name) / "network.json"
-    log_path = Path(cluster.tmpdir.name) / "gateway.log"
+    log_path = Path(cluster.tmpdir.name) / f"{name}.log"
     log = open(log_path, "wb")
     proc = subprocess.Popen(
         [sys.executable, "-m", "pbft_tpu.net.gateway", "--config", str(cfg),
-         "--port", "0"],
+         "--port", "0", *extra],
         stdout=log, stderr=log, close_fds=True,
         env=dict(os.environ, PYTHONPATH=str(REPO)),
     )
@@ -93,9 +94,13 @@ def test_gateway_exactly_once_and_quorum_fan_back():
             time.sleep(1.2)  # let a metrics tick capture the first exec
             executed_before = _replica_metric(cluster, 0, "executed")
             for _ in range(3):
-                client.request("gw-op-1", timestamp=req.timestamp)
+                # Clear BEFORE retransmitting: cached replies can land
+                # within microseconds of the send, and clearing after
+                # would wipe them (then nothing retransmits again inside
+                # wait_result — a guaranteed 30 s timeout).
                 with client._lock:
                     client.replies.clear()
+                client.request("gw-op-1", timestamp=req.timestamp)
                 assert client.wait_result(req.timestamp, timeout=30) == result
             time.sleep(1.5)
             executed_after = _replica_metric(cluster, 0, "executed")
@@ -260,4 +265,191 @@ def test_gateway_many_clients_sustained():
             )
             assert done == 200 * 3, f"completed {done}/600"
         finally:
+            _stop(proc)
+
+
+# -- gateway HA + admission control (ISSUE 12) --------------------------------
+
+
+def test_gateway_client_failover_exactly_once():
+    """Kill the gateway a client is attached to MID-REQUEST: the client
+    fails over to the second gateway under the SAME gw/ token, replays
+    its in-flight lines, and completion stays 100% — with the replicas'
+    per-(client, ts) exactly-once guard proving the replay executed
+    nothing twice (the ISSUE 12 gateway-HA acceptance pin)."""
+    with LocalCluster(
+        n=4, verifier="cpu", metrics_every=1, batch_max_items=8,
+        batch_flush_us=2000,
+    ) as cluster:
+        proc_a, addr_a = _start_gateway(cluster, name="gateway-a")
+        proc_b, addr_b = _start_gateway(cluster, name="gateway-b")
+        procs = {addr_a: proc_a, addr_b: proc_b}
+        client = None
+        try:
+            client = GatewayClient(cluster.config, [addr_a, addr_b])
+            req1 = client.request("ha-op-1")
+            result1 = client.wait_result(req1.timestamp, timeout=30)
+            assert result1 == "awesome!"
+            time.sleep(1.2)  # one metrics tick captures the execution
+            executed_before = _replica_metric(cluster, 0, "executed")
+            # Fire a request and kill the attached gateway before waiting:
+            # the death lands mid-request, the failover replay (same
+            # token, same ts) must complete it through the survivor.
+            attached = [addr_a, addr_b][client._addr_idx]
+            req2 = client.request("ha-op-2")
+            _stop(procs[attached])
+            result2 = client.wait_result(req2.timestamp, timeout=45)
+            assert result2 == "awesome!"
+            assert client.failovers >= 1
+            # Exactly-once across the failover: explicitly retransmit
+            # req2 (the request that rode the failover replay) through
+            # the surviving gateway — the replicas' reply caches answer
+            # with the SAME bytes and nothing re-executes. (req2 is the
+            # client's LATEST request: PBFT's reply cache holds exactly
+            # one reply per client, so only the latest ts can be
+            # re-answered.)
+            with client._lock:  # clear BEFORE the send (see above test)
+                client.replies.clear()
+            client.request("ha-op-2", timestamp=req2.timestamp)
+            assert client.wait_result(req2.timestamp, timeout=30) == result2
+            time.sleep(1.5)
+            executed_after = _replica_metric(cluster, 0, "executed")
+            # ha-op-2 executed once; neither the failover replay nor the
+            # explicit retransmission executed anything more.
+            assert executed_after == executed_before + 1, (
+                f"replay re-executed: {executed_before} -> {executed_after}"
+            )
+        finally:
+            if client is not None:
+                client.close()
+            for p in procs.values():
+                _stop(p)
+
+
+def test_gateway_admission_rejects_past_inflight_cap():
+    """Admission control at the gateway (ISSUE 12): with --max-inflight 2
+    and a cluster that never answers (nothing listening), the third
+    fresh request gets an explicit overloaded line back — not silence."""
+    import tempfile
+
+    from pbft_tpu.consensus.config import make_local_cluster
+
+    config, _seeds = make_local_cluster(4, base_port=1)  # ports 1-4: dead
+    with tempfile.TemporaryDirectory(prefix="gwadm-") as tmp:
+        cfg_path = Path(tmp) / "network.json"
+        cfg_path.write_text(config.to_json())
+        log_path = Path(tmp) / "gateway.log"
+        log = open(log_path, "wb")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "pbft_tpu.net.gateway", "--config",
+             str(cfg_path), "--port", "0", "--max-inflight", "2"],
+            stdout=log, stderr=log, close_fds=True,
+            env=dict(os.environ, PYTHONPATH=str(REPO)),
+        )
+        try:
+            deadline = time.monotonic() + 20
+            port = None
+            while port is None:
+                text = (
+                    log_path.read_text(errors="replace")
+                    if log_path.exists()
+                    else ""
+                )
+                m = re.search(r"gateway listening on (\d+)", text)
+                if m:
+                    port = int(m.group(1))
+                elif proc.poll() is not None or time.monotonic() > deadline:
+                    raise TimeoutError(f"gateway never listened:\n{text}")
+                else:
+                    time.sleep(0.05)
+            token = next_token("adm")
+            s = socket.create_connection(("127.0.0.1", port), timeout=10)
+            s.settimeout(10)
+            for ts in range(1, 6):  # 5 fresh requests, cap 2
+                line = json.dumps({
+                    "type": "client-request", "operation": f"op-{ts}",
+                    "timestamp": ts, "client": token,
+                }, separators=(",", ":")).encode() + b"\n"
+                s.sendall(line)
+            buf = b""
+            overloaded = []
+            deadline = time.monotonic() + 15
+            while len(overloaded) < 3 and time.monotonic() < deadline:
+                try:
+                    chunk = s.recv(65536)
+                except socket.timeout:
+                    break
+                if not chunk:
+                    break
+                buf += chunk
+                overloaded = [
+                    json.loads(ln)
+                    for ln in buf.split(b"\n")
+                    if ln.strip()
+                    and json.loads(ln).get("type") == "overloaded"
+                ]
+            s.close()
+            # Requests 3, 4, 5 were past the cap (1 and 2 hold the two
+            # in-flight slots forever — the cluster is dead).
+            assert len(overloaded) == 3, overloaded
+            assert {o["timestamp"] for o in overloaded} == {3, 4, 5}
+            assert all(o["client"] == token for o in overloaded)
+        finally:
+            _stop(proc)
+
+
+@pytest.mark.parametrize("impl", ["cxx", "py"])
+def test_replica_admission_inflight_cap_and_recovery(impl):
+    """Admission control at the REPLICA (both runtimes, ISSUE 12): with
+    admission_inflight=3 in network.json and a long batch-flush window, a
+    burst of 10 fresh requests gets explicit overloaded replies past the
+    cap — and the rejected requests still complete once the client
+    retries after the backlog drains (liveness is never admission-gated,
+    retransmissions always pass)."""
+    with LocalCluster(
+        n=4, verifier="cpu", metrics_every=1, impl=impl,
+        batch_max_items=64, batch_flush_us=500000, admission_inflight=3,
+    ) as cluster:
+        proc, addr = _start_gateway(cluster)
+        client = None
+        try:
+            client = GatewayClient(cluster.config, addr)
+            reqs = [client.request(f"burst-{k}") for k in range(10)]
+            # The primary's overloaded lines route back over the gateway.
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline:
+                with client._lock:
+                    rejected = [
+                        r for r in client.replies
+                        if r.get("type") == "overloaded"
+                    ]
+                if rejected:
+                    break
+                time.sleep(0.1)
+            assert rejected, "no overloaded reply reached the client"
+            assert all(r["timestamp"] > 3 for r in rejected)
+            # The admitted prefix completes untouched.
+            assert client.wait_result(reqs[0].timestamp, timeout=30) == (
+                "awesome!"
+            )
+            # Rejected requests complete on retry as the backlog drains.
+            done = {}
+            deadline = time.monotonic() + 90
+            while len(done) < 10 and time.monotonic() < deadline:
+                for r in reqs:
+                    if r.timestamp in done:
+                        continue
+                    try:
+                        done[r.timestamp] = client.wait_result(
+                            r.timestamp, timeout=2
+                        )
+                    except TimeoutError:
+                        client.request(r.operation, timestamp=r.timestamp)
+            assert len(done) == 10
+            time.sleep(1.5)
+            rej = _replica_metric(cluster, 0, "overload_rejections")
+            assert rej is not None and rej >= 1
+        finally:
+            if client is not None:
+                client.close()
             _stop(proc)

@@ -415,3 +415,141 @@ def test_view_event_ordering_violations_flagged():
         {"ts": 0.4, "ev": "new_view_installed", "replica": 3, "view": 2},
     ]
     assert check_view_events(installed_before_sent)
+
+
+# -- view-timer backoff + retransmission (ISSUE 12) ---------------------------
+
+
+def test_view_timer_backoff_policy_escalates_and_caps():
+    """§4.5.2 exponential backoff, as the runtimes run it (server.py
+    ViewTimerBackoff; core/net.cc mirrors the state machine): arm at
+    T x level, double per consecutive no-progress expiry, cap at 64."""
+    from pbft_tpu.net.server import ViewTimerBackoff
+
+    p = ViewTimerBackoff(1.0)
+    assert p.poll(0.0, 0, 0, False) == "armed"
+    assert p.deadline == 1.0
+    assert p.poll(0.5, 0, 0, False) == "idle"
+    assert p.poll(1.1, 0, 0, False) == "escalate"
+    assert p.level == 2
+    assert p.poll(1.2, 0, 0, False) == "armed"
+    assert p.deadline == 1.2 + 2.0  # T x level
+    now = 1.2
+    for _ in range(10):  # drive to the cap
+        now = p.deadline + 0.1
+        assert p.poll(now, 0, 0, False) == "escalate"
+        assert p.poll(now, 0, 0, False) == "armed"
+    assert p.level == ViewTimerBackoff.MAX_LEVEL == 64
+    p.clear()
+    assert p.level == 1 and p.deadline is None
+
+
+def test_view_timer_backoff_resets_on_progress():
+    from pbft_tpu.net.server import ViewTimerBackoff
+
+    p = ViewTimerBackoff(1.0)
+    assert p.poll(0.0, 5, 2, False) == "armed"
+    assert p.poll(2.0, 6, 2, False) == "progress"  # executed advanced
+    assert p.level == 1
+    assert p.poll(2.1, 6, 2, False) == "armed"
+    assert p.poll(3.5, 6, 3, False) == "progress"  # view advanced
+    assert p.level == 1
+
+
+def test_view_timer_backoff_retransmits_before_escalating():
+    """Mid-view-change, the FIRST no-progress expiry retransmits the
+    pending VIEW-CHANGE (same view, lost-frame recovery); only the next
+    one escalates and doubles — repeated timer fires must not burn a
+    view number each (ISSUE 12)."""
+    from pbft_tpu.net.server import ViewTimerBackoff
+
+    p = ViewTimerBackoff(1.0)
+    assert p.poll(0.0, 0, 0, True) == "armed"
+    assert p.poll(1.1, 0, 0, True) == "retransmit"
+    assert p.level == 1  # retransmission never doubles
+    assert p.poll(1.2, 0, 0, True) == "armed"
+    assert p.poll(2.3, 0, 0, True) == "escalate"
+    assert p.level == 2
+    # After escalation the cycle repeats: retransmit, then escalate.
+    assert p.poll(2.4, 0, 0, True) == "armed"
+    assert p.poll(4.5, 0, 0, True) == "retransmit"
+    assert p.poll(4.6, 0, 0, True) == "armed"
+    assert p.poll(6.7, 0, 0, True) == "escalate"
+    assert p.level == 4
+
+
+def _direct_replicas(n=4):
+    config, seeds = make_local_cluster(n, base_port=0)
+    return [Replica(config, i, seeds[i]) for i in range(n)], config
+
+
+def _deliver(replica, msg):
+    """Feed one replica-to-replica message through the verify queue."""
+    out = list(replica.receive(msg))
+    out += replica.deliver_verdicts([True] * replica.pending_count())
+    return out
+
+
+def _own_view_change(actions):
+    for a in actions:
+        if isinstance(a, Broadcast) and isinstance(a.msg, ViewChange):
+            return a.msg
+    raise AssertionError("no ViewChange broadcast in actions")
+
+
+def test_retransmit_view_change_is_verbatim_and_free():
+    """retransmit_view_change re-broadcasts the SAME signed message: no
+    counter moves, no re-signing, and outside a view change it is a
+    no-op (ISSUE 12)."""
+    replicas, _ = _direct_replicas()
+    r = replicas[2]
+    assert r.retransmit_view_change() == []  # not in a view change
+    vc = _own_view_change(r.start_view_change())
+    started = r.counters["view_changes_started"]
+    out = r.retransmit_view_change()
+    assert len(out) == 1 and isinstance(out[0], Broadcast)
+    assert out[0].msg == vc  # verbatim: same content, same signature
+    assert r.counters["view_changes_started"] == started
+
+
+def test_primary_resends_cached_new_view_to_laggard():
+    """A VIEW-CHANGE arriving for a view the receiver already LEADS is a
+    laggard signalling it missed the NEW-VIEW broadcast: the primary
+    answers with the cached NEW-VIEW, point-to-point, without
+    recomputing O or re-broadcasting (ISSUE 12)."""
+    replicas, config = _direct_replicas()
+    r1, r2, r3 = replicas[1], replicas[2], replicas[3]
+    vc2 = _own_view_change(r2.start_view_change())
+    vc3 = _own_view_change(r3.start_view_change())
+    out = list(r1.start_view_change())  # r1 logs its own VC
+    out += _deliver(r1, vc2)
+    out += _deliver(r1, vc3)  # 2f+1 = 3 -> NEW-VIEW built + view entered
+    assert r1.view == 1 and not r1.in_view_change
+    nv_broadcasts = [
+        a
+        for a in out
+        if isinstance(a, Broadcast) and isinstance(a.msg, NewView)
+    ]
+    assert len(nv_broadcasts) == 1
+    # Laggard r2 retransmits its VIEW-CHANGE (its timer fired again):
+    # the primary resends the cached NEW-VIEW to r2 alone.
+    from pbft_tpu.consensus.replica import Send
+
+    resend = _deliver(r1, vc2)
+    sends = [a for a in resend if isinstance(a, Send)]
+    assert len(sends) == 1
+    assert sends[0].dest == 2
+    assert isinstance(sends[0].msg, NewView)
+    assert sends[0].msg == nv_broadcasts[0].msg  # cached, not recomputed
+    # No second broadcast, no double-entry.
+    assert not any(
+        isinstance(a, Broadcast) and isinstance(a.msg, NewView)
+        for a in resend
+    )
+    assert r1.counters["view_changes_completed"] == 1
+    # The resent NEW-VIEW actually installs the view on the laggard.
+    for a in _deliver(r2, vc3):
+        pass
+    entered = _deliver(r2, sends[0].msg)
+    del entered
+    assert r2.view == 1 and not r2.in_view_change
