@@ -852,6 +852,103 @@ void test_net_backend_parity() {
 #endif
 }
 
+// ISSUE 13: the multi-core front end (net_threads > 1: SO_REUSEPORT
+// accept sharding, loop shards + crypto pipelines + consensus thread)
+// must drive a real-socket 4-replica cluster to the SAME executed state
+// as the classic single loop. Two sequential requests per arm; returns
+// the cluster-wide max executed_upto after a clean stop.
+int64_t multicore_round(int net_threads) {
+  int ports[4];
+  int hold[4];
+  for (int i = 0; i < 4; ++i) {
+    hold[i] = parity_listen_ephemeral(&ports[i]);
+    CHECK(hold[i] >= 0);
+  }
+  pbft::ClusterConfig cfg;
+  cfg.net_threads = net_threads;
+  std::vector<std::vector<uint8_t>> seeds;
+  for (int i = 0; i < 4; ++i) {
+    std::vector<uint8_t> seed(32, (uint8_t)(i + 73));
+    pbft::ReplicaIdentity ident;
+    ident.replica_id = i;
+    ident.host = "127.0.0.1";
+    ident.port = ports[i];
+    pbft::ed25519_public_key(ident.pubkey, seed.data());
+    cfg.replicas.push_back(ident);
+    seeds.push_back(seed);
+  }
+  for (int i = 0; i < 4; ++i) ::close(hold[i]);
+  std::vector<std::unique_ptr<pbft::ReplicaServer>> servers;
+  for (int i = 0; i < 4; ++i) {
+    servers.push_back(std::make_unique<pbft::ReplicaServer>(
+        cfg, i, seeds[i].data(), std::make_unique<pbft::CpuVerifier>()));
+    CHECK(servers[i]->start());
+  }
+  std::vector<std::thread> loops;
+  for (int i = 0; i < 4; ++i) {
+    loops.emplace_back([srv = servers[i].get()] { srv->run(); });
+  }
+  int reply_port = 0;
+  int reply_fd = parity_listen_ephemeral(&reply_port);
+  CHECK(reply_fd >= 0);
+  const std::string reply_addr = "127.0.0.1:" + std::to_string(reply_port);
+  for (int ts = 1; ts <= 2; ++ts) {
+    const std::string req =
+        "{\"type\":\"client-request\",\"operation\":\"mc-" +
+        std::to_string(ts) + "\",\"timestamp\":" + std::to_string(ts) +
+        ",\"client\":\"" + reply_addr + "\"}\n";
+    int replies = 0;
+    auto deadline =
+        std::chrono::steady_clock::now() + std::chrono::seconds(30);
+    int attempt = 0;
+    while (replies < 2 && std::chrono::steady_clock::now() < deadline) {
+      int fd = pbft::dial_tcp("127.0.0.1:" +
+                              std::to_string(ports[attempt++ % 4]));
+      if (fd >= 0) {
+        (void)!::send(fd, req.data(), req.size(), MSG_NOSIGNAL);
+        ::close(fd);
+      }
+      auto retry_at =
+          std::chrono::steady_clock::now() + std::chrono::milliseconds(400);
+      while (replies < 2 && std::chrono::steady_clock::now() < retry_at) {
+        pollfd pfd{reply_fd, POLLIN, 0};
+        if (::poll(&pfd, 1, 50) <= 0) continue;
+        int cfd = ::accept(reply_fd, nullptr, nullptr);
+        if (cfd < 0) continue;
+        char buf[512];
+        if (::recv(cfd, buf, sizeof(buf) - 1, 0) > 0) ++replies;
+        ::close(cfd);
+      }
+    }
+    CHECK(replies >= 2);  // f+1 distinct dial-backs per request
+  }
+  // Let the trailing commits land everywhere before the stop.
+  auto settle = std::chrono::steady_clock::now() + std::chrono::seconds(2);
+  while (std::chrono::steady_clock::now() < settle) {
+    std::this_thread::sleep_for(std::chrono::milliseconds(50));
+  }
+  for (auto& s : servers) s->stop();
+  for (auto& t : loops) t.join();
+  int64_t max_executed = 0;
+  for (auto& s : servers) {
+    max_executed = std::max(max_executed, s->replica().executed_upto());
+    CHECK(s->replica().executed_upto() >= 1);
+  }
+  ::close(reply_fd);
+  return max_executed;
+}
+
+void test_multicore_parity() {
+  const int64_t e1 = multicore_round(1);
+  const int64_t e2 = multicore_round(2);
+  const int64_t e4 = multicore_round(4);
+  // Identical executed state across net-threads {1,2,4}: the shard tier
+  // changes where the work runs, never what the cluster decides.
+  CHECK(e1 == 2);
+  CHECK(e2 == e1);
+  CHECK(e4 == e1);
+}
+
 void test_flight_recorder() {
   pbft::FlightRecorder fl;
   // Disabled (unconfigured) recorder: record is a no-op, dump refuses.
@@ -910,6 +1007,7 @@ int main() {
   test_remote_verifier_async();
   test_remote_verifier_readiness();
   test_net_backend_parity();
+  test_multicore_parity();
   test_flight_recorder();
   if (g_failures) {
     std::fprintf(stderr, "%d failure(s)\n", g_failures);

@@ -45,6 +45,9 @@ const char* kCounterNames[] = {
     // rejections and gateway-fabric link replacements (a replica losing a
     // live gateway link).
     "pbft_overload_rejections_total", "pbft_gateway_failovers_total",
+    // Multi-core surface (ISSUE 13): eventfd/pipe wakes crossing the
+    // loop-shard / crypto-pipeline / consensus thread boundaries.
+    "pbft_cross_thread_wakes_total",
 };
 const char* kGaugeNames[] = {
     "pbft_verify_queue_depth",
@@ -63,6 +66,11 @@ const char* kGaugeNames[] = {
     // View-timer backoff level (ISSUE 12, §4.5.2): 1 = fresh, doubles
     // per consecutive no-progress expiry — sustained high = no converge.
     "pbft_view_timer_backoff_level",
+    // Multi-core surface (ISSUE 13): event-loop shard threads this
+    // replica runs (1 = classic single loop) and the aggregate depth of
+    // the crypto-pipeline offload queues.
+    "pbft_net_loop_threads",
+    "pbft_crypto_offload_queue_depth",
 };
 // name -> uses the size bucket ladder (else latency).
 const std::pair<const char*, bool> kHistogramNames[] = {

@@ -21,6 +21,7 @@
 
 #include "ed25519.h"
 #include "flight.h"
+#include "net_shard.h"
 #include "verify_pool.h"
 
 namespace pbft {
@@ -211,6 +212,8 @@ namespace {
 constexpr uint64_t kTagListener = 1;
 constexpr uint64_t kTagMetrics = 2;
 constexpr uint64_t kTagVerifier = 3;
+// Multi-core mode (ISSUE 13): the shard->consensus inbox wake fd.
+constexpr uint64_t kTagShardWake = 4;
 
 // Bounded outbound queue per connection (ISSUE 10 satellite): past this,
 // frames are dropped and counted instead of growing without limit
@@ -226,6 +229,11 @@ constexpr size_t kMaxSendBlock = 64u << 10;
 // extra frames, never lost quorums.
 constexpr size_t kMaxGatewayRoutes = 1u << 17;
 }  // namespace
+
+// Shared with the shard/pipeline tier (core/net_shard.cc); the values
+// stay declared above so the constants lint keeps reading them here.
+size_t max_conn_outbound() { return kMaxConnOutbound; }
+size_t max_send_block() { return kMaxSendBlock; }
 
 const char* ReplicaServer::net_backend() const { return poller_->name(); }
 
@@ -272,6 +280,10 @@ ReplicaServer::ReplicaServer(ClusterConfig cfg, int64_t id,
 }
 
 ReplicaServer::~ReplicaServer() {
+  // Multi-core mode: the shard/pipeline threads reference this object's
+  // config/seed and queues — stop and join them before anything tears
+  // down (stop_join sets stopping_ and wakes every thread).
+  if (shards_) shards_->stop_join();
   if (trace_fp_) std::fclose(trace_fp_);
   if (listen_fd_ >= 0) close(listen_fd_);
   if (metrics_listen_fd_ >= 0) close(metrics_listen_fd_);
@@ -282,20 +294,35 @@ ReplicaServer::~ReplicaServer() {
 }
 
 bool ReplicaServer::start() {
-  listen_fd_ = socket(AF_INET, SOCK_STREAM, 0);
-  if (listen_fd_ < 0) return false;
-  tune_listen_socket(listen_fd_);
-  sockaddr_in addr{};
-  addr.sin_family = AF_INET;
-  addr.sin_addr.s_addr = htonl(INADDR_ANY);
-  addr.sin_port = htons((uint16_t)cfg_.replicas[id_].port);
-  if (bind(listen_fd_, (sockaddr*)&addr, sizeof(addr)) != 0) return false;
-  if (listen(listen_fd_, 128) != 0) return false;
-  socklen_t len = sizeof(addr);
-  getsockname(listen_fd_, (sockaddr*)&addr, &len);
-  listen_port_ = ntohs(addr.sin_port);
-  set_nonblocking(listen_fd_);
-  poller_->add(listen_fd_, kTagListener, /*edge=*/false);
+  if (cfg_.net_threads > 1) {
+    // Multi-core front end (ISSUE 13): N loop shards own the listeners
+    // (SO_REUSEPORT accept sharding) and every data socket; this thread
+    // keeps only the metrics listener, the verifier stream, and the
+    // shard-inbox wake fd on its poller.
+    shards_ = std::make_unique<NetShards>(cfg_, id_, seed_, &stopping_,
+                                          (int)cfg_.net_threads);
+    shards_->set_chaos(chaos_drop_pct_, chaos_delay_ms_, chaos_seed_);
+    if (!shards_->start(&listen_port_)) return false;
+    poller_->add(shards_->wake_fd(), kTagShardWake, /*edge=*/false);
+    metrics_.set_gauge("pbft_net_loop_threads",
+                       (double)shards_->n_shards());
+  } else {
+    metrics_.set_gauge("pbft_net_loop_threads", 1.0);
+    listen_fd_ = socket(AF_INET, SOCK_STREAM, 0);
+    if (listen_fd_ < 0) return false;
+    tune_listen_socket(listen_fd_);
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    addr.sin_port = htons((uint16_t)cfg_.replicas[id_].port);
+    if (bind(listen_fd_, (sockaddr*)&addr, sizeof(addr)) != 0) return false;
+    if (listen(listen_fd_, 128) != 0) return false;
+    socklen_t len = sizeof(addr);
+    getsockname(listen_fd_, (sockaddr*)&addr, &len);
+    listen_port_ = ntohs(addr.sin_port);
+    set_nonblocking(listen_fd_);
+    poller_->add(listen_fd_, kTagListener, /*edge=*/false);
+  }
   if (metrics_port_ >= 0) {
     metrics_listen_fd_ = socket(AF_INET, SOCK_STREAM, 0);
     sockaddr_in maddr{};
@@ -412,6 +439,13 @@ void ReplicaServer::poll_once(int timeout_ms) {
       }
       continue;
     }
+    if (ev.tag == kTagShardWake) {
+      // Multi-core mode: parsed messages (and gateway-link lifecycle)
+      // from the crypto pipelines. Level-triggered: readable persists
+      // until the inbox drains, so a wake is never lost.
+      if (ev.readable) process_shard_inbound();
+      continue;
+    }
     Conn* c = reinterpret_cast<Conn*>((uintptr_t)ev.tag);
     // A conn closed earlier THIS iteration still owns its (stale) event:
     // the object lives until the end-of-pass sweep, so the flag check is
@@ -436,6 +470,7 @@ void ReplicaServer::poll_once(int timeout_ms) {
   run_verify_batch();
   pump_chaos_queue(std::chrono::steady_clock::now());  // release held frames
   pump_reply_backlog();  // launch queued reply dials as slots free
+  aggregate_shard_metrics();  // multi-core mode: fold shard counters in
   check_progress_timer();
   if (discovery_) {
     discovery_->poll(&discovered_addrs_);
@@ -453,6 +488,14 @@ void ReplicaServer::poll_once(int timeout_ms) {
 // connections-open gauge. Runs once per iteration AFTER event dispatch —
 // a Conn closed mid-pass must outlive any stale event referencing it.
 void ReplicaServer::sweep_conns() {
+  if (shards_) {
+    // Multi-core mode: sweep bookkeeping is per-shard (each shard reaps
+    // its own overdue connects — the ISSUE 13 satellite); this thread
+    // only refreshes the aggregate gauge.
+    metrics_.set_gauge("pbft_connections_open",
+                       (double)shards_->connections_open());
+    return;
+  }
   const auto now = std::chrono::steady_clock::now();
   connecting_count_ = 0;
   auto visit = [&](Conn& c) {
@@ -481,6 +524,91 @@ void ReplicaServer::sweep_conns() {
   }
   metrics_.set_gauge("pbft_connections_open",
                      (double)(conns_.size() + peers_.size()));
+}
+
+// Pack a shard-owned gateway link into one route-table key (shard index
+// in the top bits, the shard-local conn token below). Shard counts are
+// tiny and tokens monotonically count accepted conns — 48 bits is years
+// of churn.
+namespace {
+inline uint64_t shard_link_key(int shard, uint64_t conn_id) {
+  return ((uint64_t)shard << 48) | (conn_id & ((1ull << 48) - 1));
+}
+}  // namespace
+
+void ReplicaServer::process_shard_inbound() {
+  std::deque<KInbound> in;
+  shards_->drain_inbox(&in);
+  for (auto& k : in) {
+    const uint64_t key = shard_link_key(k.shard, k.conn_id);
+    if (k.kind == KInbound::kGatewayUp) {
+      sharded_gateways_.insert(key);
+      continue;
+    }
+    if (k.kind == KInbound::kGatewayDown) {
+      if (sharded_gateways_.erase(key) > 0 && !stopping_) {
+        ++gateway_failovers_;
+        metrics_.inc("pbft_gateway_failovers_total");
+        FlightRecorder& fl = global_flight();
+        if (fl.enabled()) {
+          fl.record(kFlightGatewayFailover, replica_->view(),
+                    (int64_t)k.conn_id, -1);
+        }
+      }
+      continue;
+    }
+    if (!k.msg) continue;
+    ++frames_in_;
+    metrics_.inc("pbft_frames_in_total");
+    if (auto* req = std::get_if<ClientRequest>(&*k.msg)) {
+      if (k.from_gateway) {
+        note_gateway_route(req->client, key);
+        ++gateway_forwarded_;
+        metrics_.inc("pbft_gateway_forwarded_total");
+      }
+      if (!maybe_reject_overload(*req)) {
+        trace_request_rx(*req);
+        emit(replica_->receive(*k.msg));
+      }
+    } else if (k.has_signable) {
+      emit(replica_->receive(*k.msg, k.signable));
+    } else {
+      emit(replica_->receive(*k.msg));
+    }
+  }
+}
+
+void ReplicaServer::aggregate_shard_metrics() {
+  if (!shards_) return;
+  auto delta = [&](int64_t now_abs, int64_t* seen, const char* name) {
+    if (now_abs > *seen) {
+      metrics_.inc(name, now_abs - *seen);
+      *seen = now_abs;
+    }
+  };
+  delta(shards_->total_wakeups(), &seen_shard_wakeups_,
+        "pbft_epoll_wakeups_total");
+  delta(shards_->cross_thread_wakes(), &seen_cross_wakes_,
+        "pbft_cross_thread_wakes_total");
+  delta(shards_->codec_binary_frames(), &seen_codec_bin_,
+        "pbft_codec_binary_frames_total");
+  delta(shards_->codec_json_frames(), &seen_codec_json_,
+        "pbft_codec_json_frames_total");
+  delta(shards_->backpressure_events(), &seen_shard_backpressure_,
+        "pbft_write_backpressure_events_total");
+  delta(shards_->chaos_dropped(), &seen_shard_chaos_,
+        "pbft_chaos_dropped_total");
+  delta(shards_->broadcast_encodes(), &seen_shard_encodes_,
+        "pbft_broadcast_encodes_total");
+  metrics_.set_gauge("pbft_crypto_offload_queue_depth",
+                     (double)shards_->crypto_queue_depth());
+}
+
+std::string ReplicaServer::peer_addr(int64_t dest) {
+  const auto& ident = cfg_.replicas[dest];
+  if (ident.port != 0) return ident.host + ":" + std::to_string(ident.port);
+  auto d = discovered_addrs_.find(dest);  // mDNS-equivalent addressing
+  return d == discovered_addrs_.end() ? std::string() : d->second;
 }
 
 void ReplicaServer::register_conn(Conn& c) {
@@ -620,7 +748,6 @@ void ReplicaServer::process_buffer(Conn& c) {
   }
 }
 
-namespace {
 std::string frame_payload(const std::string& payload) {
   uint32_t n = (uint32_t)payload.size();
   std::string out;
@@ -632,7 +759,6 @@ std::string frame_payload(const std::string& payload) {
   out += payload;
   return out;
 }
-}  // namespace
 
 void ReplicaServer::count_backpressure() {
   ++backpressure_events_;
@@ -1371,6 +1497,34 @@ Message ReplicaServer::equivocate_variant(const PrePrepare& pp) {
   return m;
 }
 
+// Serialize-once fan-out on whichever front end is active. Single loop:
+// ONE canonical encode (and at most one binary-v2 encode, when any link
+// negotiated it) per broadcast via EncodedOut — the per-peer loop is pick
+// codec, seal (secure links), memcpy, flush. Multi-core: one ShardEncoded
+// shared by every pipeline, whose lazy encodes run OFF this thread and
+// still happen at most once per codec (its internal mutex), tallied into
+// the shards' encode counter and folded into the metric by
+// aggregate_shard_metrics.
+void ReplicaServer::broadcast_message(const Message& m) {
+  if (shards_) {
+    auto enc = std::make_shared<ShardEncoded>(m, &shards_->encodes_total);
+    for (int64_t dest = 0; dest < cfg_.n(); ++dest) {
+      if (dest == id_) continue;
+      std::string addr = peer_addr(dest);
+      if (!addr.empty()) shards_->send_peer(dest, addr, enc);
+    }
+    ++broadcasts_;
+    return;
+  }
+  EncodedOut enc(&m);
+  for (int64_t dest = 0; dest < cfg_.n(); ++dest) {
+    if (dest != id_) send_encoded(dest, enc);
+  }
+  ++broadcasts_;
+  broadcast_encodes_ += enc.encodes;
+  metrics_.inc("pbft_broadcast_encodes_total", enc.encodes);
+}
+
 void ReplicaServer::emit(Actions&& actions) {
   const bool mute = fault_mode_ == FaultMode::kMute;
   for (auto& b : actions.broadcasts) {
@@ -1395,24 +1549,37 @@ void ReplicaServer::emit(Actions&& actions) {
       auto* pp = std::get_if<PrePrepare>(&b.msg);
       if (pp && pp->replica == id_ && !pp->requests.empty()) {
         Message variant = equivocate_variant(*pp);
-        EncodedOut enc_a(&b.msg);
-        EncodedOut enc_b(&variant);
-        for (int64_t dest = 0; dest < cfg_.n(); ++dest) {
-          if (dest != id_) send_encoded(dest, dest % 2 == 0 ? enc_a : enc_b);
+        if (shards_) {
+          auto enc_a =
+              std::make_shared<ShardEncoded>(b.msg, &shards_->encodes_total);
+          auto enc_b =
+              std::make_shared<ShardEncoded>(variant, &shards_->encodes_total);
+          for (int64_t dest = 0; dest < cfg_.n(); ++dest) {
+            if (dest == id_) continue;
+            std::string addr = peer_addr(dest);
+            if (!addr.empty()) {
+              shards_->send_peer(dest, addr, dest % 2 == 0 ? enc_a : enc_b);
+            }
+          }
+        } else {
+          EncodedOut enc_a(&b.msg);
+          EncodedOut enc_b(&variant);
+          for (int64_t dest = 0; dest < cfg_.n(); ++dest) {
+            if (dest != id_) {
+              send_encoded(dest, dest % 2 == 0 ? enc_a : enc_b);
+            }
+          }
+          broadcast_encodes_ += enc_a.encodes + enc_b.encodes;
+          metrics_.inc("pbft_broadcast_encodes_total",
+                       enc_a.encodes + enc_b.encodes);
         }
         count_fault();
         ++broadcasts_;
-        broadcast_encodes_ += enc_a.encodes + enc_b.encodes;
-        metrics_.inc("pbft_broadcast_encodes_total",
-                     enc_a.encodes + enc_b.encodes);
         continue;
       }
     }
-    // Serialize-once fan-out: ONE canonical encode (and at most one
-    // binary-v2 encode, when any link negotiated it) per broadcast,
-    // shared across every destination — the per-peer loop is pick codec,
-    // seal (secure links), memcpy, flush. The Byzantine corruption is
-    // applied once too: every peer sees the same garbage signature.
+    // The Byzantine corruption is applied once: every peer sees the same
+    // garbage signature.
     Message corrupted;
     const Message* mp = &b.msg;
     if (fault_mode_ == FaultMode::kSigCorrupt) {
@@ -1420,13 +1587,7 @@ void ReplicaServer::emit(Actions&& actions) {
       mp = &corrupted;
       count_fault();
     }
-    EncodedOut enc(mp);
-    for (int64_t dest = 0; dest < cfg_.n(); ++dest) {
-      if (dest != id_) send_encoded(dest, enc);
-    }
-    ++broadcasts_;
-    broadcast_encodes_ += enc.encodes;
-    metrics_.inc("pbft_broadcast_encodes_total", enc.encodes);
+    broadcast_message(*mp);
     if (fault_mode_ == FaultMode::kStutter) {
       // Seeded stale replays: rebroadcast an old (validly signed)
       // message alongside the fresh one. Honest replicas must treat the
@@ -1437,14 +1598,8 @@ void ReplicaServer::emit(Actions&& actions) {
                                    chaos_rng_) *
                                stutter_history_.size());
         if (pick >= stutter_history_.size()) pick = 0;
-        EncodedOut stale(&stutter_history_[pick]);
-        for (int64_t dest = 0; dest < cfg_.n(); ++dest) {
-          if (dest != id_) send_encoded(dest, stale);
-        }
+        broadcast_message(stutter_history_[pick]);
         count_fault();
-        ++broadcasts_;
-        broadcast_encodes_ += stale.encodes;
-        metrics_.inc("pbft_broadcast_encodes_total", stale.encodes);
       }
       stutter_history_.push_back(b.msg);
       if (stutter_history_.size() > 32) stutter_history_.pop_front();
@@ -1617,13 +1772,8 @@ int ReplicaServer::peer_fd(int64_t dest) {
     // message is retransmission-covered, as any PBFT loss is.
     return -1;
   }
-  const auto& ident = cfg_.replicas[dest];
-  std::string addr = ident.host + ":" + std::to_string(ident.port);
-  if (ident.port == 0) {  // discovery-addressed peer (mDNS equivalent)
-    auto d = discovered_addrs_.find(dest);
-    if (d == discovered_addrs_.end()) return -1;
-    addr = d->second;
-  }
+  std::string addr = peer_addr(dest);
+  if (addr.empty()) return -1;  // discovery hasn't named this peer yet
   bool in_progress = false;
   int fd = dial_tcp_nb(addr, &in_progress);
   if (fd < 0) return -1;
@@ -1666,6 +1816,16 @@ void ReplicaServer::send_to(int64_t dest, const Message& m) {
     corrupted = corrupt_sig(m);
     mp = &corrupted;
     count_fault();
+  }
+  if (shards_) {
+    // Point-to-point send: no broadcast-encode accounting (null tally),
+    // matching the single-loop path below.
+    std::string addr = peer_addr(dest);
+    if (!addr.empty()) {
+      shards_->send_peer(dest, addr,
+                         std::make_shared<ShardEncoded>(*mp, nullptr));
+    }
+    return;
   }
   EncodedOut enc(mp);
   send_encoded(dest, enc);
@@ -1793,6 +1953,37 @@ void ReplicaServer::dial_reply(const std::string& client_addr,
 
 void ReplicaServer::send_client_line(const std::string& client_addr,
                                      const std::string& payload) {
+  if (shards_) {
+    // Multi-core mode: gateway links live in their shards; the route
+    // table stores packed (shard, token) keys. Same policy as below —
+    // exact route, else fan out over every live gateway link, else the
+    // retransmission path re-fetches the cached reply. Non-gateway
+    // addresses dial back from a shard picked by address hash (keeps the
+    // one-in-flight-per-address invariant within one shard).
+    if (client_addr.compare(0, 3, kGatewayClientPrefix) == 0) {
+      auto rt = gateway_routes_.find(client_addr);
+      if (rt != gateway_routes_.end()) {
+        if (sharded_gateways_.count(rt->second)) {
+          shards_->send_gateway_line((int)(rt->second >> 48),
+                                     rt->second & ((1ull << 48) - 1),
+                                     payload);
+          return;
+        }
+        gateway_routes_.erase(rt);  // link died: fall through to fan-out
+      }
+      if (sharded_gateways_.empty()) {
+        ++replies_dropped_;
+        return;
+      }
+      for (uint64_t key : sharded_gateways_) {
+        shards_->send_gateway_line((int)(key >> 48),
+                                   key & ((1ull << 48) - 1), payload);
+      }
+      return;
+    }
+    shards_->dial_reply(client_addr, payload + "\n");
+    return;
+  }
   if (client_addr.compare(0, 3, kGatewayClientPrefix) == 0) {
     // Gateway-routed client (ISSUE 10): the "address" is a routing
     // token, never dialable. Exact route when this replica saw the
@@ -1944,10 +2135,32 @@ std::string ReplicaServer::metrics_json() const {
   o["port"] = Json(listen_port_);
   o["net_backend"] = Json(std::string(poller_->name()));
   o["frames_in"] = Json(frames_in_);
-  o["connections_open"] = Json((int64_t)(conns_.size() + peers_.size()));
-  o["event_wakeups"] = Json(event_wakeups_);
-  o["backpressure_events"] = Json(backpressure_events_);
-  o["gateway_links"] = Json((int64_t)gateway_links_.size());
+  // Multi-core surface (ISSUE 13): loop-thread count, aggregate crypto
+  // offload queue depth, cross-thread wake count, and the per-shard
+  // wakeup attribution for pbft_epoll_wakeups_total.
+  o["net_threads"] = Json(shards_ ? (int64_t)shards_->n_shards() : 1);
+  o["cross_thread_wakes"] =
+      Json(shards_ ? shards_->cross_thread_wakes() : 0);
+  o["crypto_offload_queue_depth"] =
+      Json(shards_ ? shards_->crypto_queue_depth() : 0);
+  if (shards_) {
+    JsonArray sw;
+    for (int i = 0; i < shards_->n_shards(); ++i) {
+      sw.push_back(Json(shards_->shard_wakeups(i)));
+    }
+    o["shard_wakeups"] = Json(std::move(sw));
+  }
+  o["connections_open"] =
+      Json(shards_ ? shards_->connections_open()
+                   : (int64_t)(conns_.size() + peers_.size()));
+  o["event_wakeups"] =
+      Json(event_wakeups_ + (shards_ ? shards_->total_wakeups() : 0));
+  o["backpressure_events"] =
+      Json(backpressure_events_ +
+           (shards_ ? shards_->backpressure_events() : 0));
+  o["gateway_links"] =
+      Json((int64_t)(shards_ ? sharded_gateways_.size()
+                             : gateway_links_.size()));
   o["gateway_forwarded"] = Json(gateway_forwarded_);
   // Perf-under-faults surface (ISSUE 12).
   o["overload_rejections"] = Json(overload_rejections_);
@@ -1955,11 +2168,14 @@ std::string ReplicaServer::metrics_json() const {
   o["view_timer_backoff"] = Json((int64_t)timer_backoff_);
   o["verify_batches"] = Json(batches_run_);
   o["broadcasts"] = Json(broadcasts_);
-  o["broadcast_encodes"] = Json(broadcast_encodes_);
+  o["broadcast_encodes"] =
+      Json(broadcast_encodes_ +
+           (shards_ ? shards_->broadcast_encodes() : 0));
   o["reply_backlog"] = Json((int64_t)reply_backlog_.size());
   o["replies_dropped"] = Json(replies_dropped_);
   o["faults_injected"] = Json(faults_injected_);
-  o["chaos_dropped"] = Json(chaos_dropped_);
+  o["chaos_dropped"] =
+      Json(chaos_dropped_ + (shards_ ? shards_->chaos_dropped() : 0));
   o["verify_deadline_fired"] = Json(verify_deadline_fired_);
   o["executed_upto"] = Json(replica_->executed_upto());
   o["low_mark"] = Json(replica_->low_mark());

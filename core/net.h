@@ -23,6 +23,15 @@
 // client-gateway tier (pbft_tpu/net/gateway.py) multiplexes thousands of
 // client identities onto a few persistent framed links whose replies fan
 // back over the SAME link instead of per-reply dial-backs.
+//
+// ISSUE 13 (multi-core): with net_threads > 1 (network.json / pbftd
+// --net-threads) the socket work moves to N event-loop shard threads
+// (SO_REUSEPORT accept sharding, per-fd ownership) and AEAD seal/open +
+// payload codec work to per-shard crypto pipelines (core/net_shard.h);
+// THIS class then runs only the consensus thread — Replica, verify
+// windows, timers, tracing, metrics — fed by bounded SPSC queues with an
+// eventfd wake. net_threads == 1 is the classic single-threaded loop,
+// byte-for-byte the pre-ISSUE-13 behavior.
 #pragma once
 
 #include <array>
@@ -61,6 +70,16 @@ void tune_listen_socket(int fd);
 // reply that cannot be routed over a gateway link is dropped for the
 // retransmission path, not dialed.
 inline constexpr const char* kGatewayClientPrefix = "gw/";
+
+// 4-byte big-endian length prefix + payload (the framed wire format).
+// Shared by the single-threaded loop and the shard/pipeline tier.
+std::string frame_payload(const std::string& payload);
+
+// Bounded-outbound / send-block coalescing budgets (values live in
+// net.cc next to their policy comments; the constants lint reads them
+// there — these accessors let core/net_shard.cc share them).
+size_t max_conn_outbound();
+size_t max_send_block();
 
 // Reusable receive buffer: consumption advances an offset instead of
 // erase(0, n)'s per-frame memmove; the storage compacts lazily and resets
@@ -218,6 +237,14 @@ struct Conn {
   bool backpressured = false;
   std::unique_ptr<SecureChannel> chan;
   std::vector<std::string> pending;  // outbound payloads queued pre-handshake
+  // Multi-core mode only (core/net_shard.h). shard_token keys the conn in
+  // its shard's registries; offloaded flips once the link prologue is
+  // done and frames flow to the crypto pipeline; out_gauge mirrors the
+  // send queue's byte count so the pipeline can run bounded-outbound
+  // admission BEFORE the AEAD seal without touching shard-owned state.
+  uint64_t shard_token = 0;
+  bool offloaded = false;
+  std::shared_ptr<std::atomic<int64_t>> out_gauge;
 };
 
 // A message mid-fan-out: canonical JSON and binary-v2 encodings are
@@ -264,6 +291,8 @@ enum class FaultMode { kNone, kSigCorrupt, kMute, kStutter, kEquivocate };
 // "" / "none" -> kNone, "sig-corrupt"/"byzantine" -> kSigCorrupt, etc.
 // Returns false on an unknown mode name.
 bool fault_mode_from_string(const std::string& s, FaultMode* out);
+
+class NetShards;  // multi-core front end (core/net_shard.h)
 
 class ReplicaServer {
  public:
@@ -342,6 +371,7 @@ class ReplicaServer {
   void set_chaos(double drop_pct, int delay_ms, uint64_t seed) {
     chaos_drop_pct_ = drop_pct;
     chaos_delay_ms_ = delay_ms;
+    chaos_seed_ = seed;
     chaos_rng_.seed(seed);
   }
 
@@ -427,6 +457,20 @@ class ReplicaServer {
   int peer_fd(int64_t dest);  // cached outbound connection (lazy dial)
 
   void check_progress_timer();
+  // Multi-core mode (ISSUE 13): the address a peer link should dial
+  // (config table or discovery), "" when unknown — shared by the
+  // single-loop lazy dial and the sharded send path.
+  std::string peer_addr(int64_t dest);
+  // Fan one message out to every peer, serialize-once, on whichever
+  // front end (single loop / shard tier) is active. Returns the shared
+  // sharded encoding when one was built (equivocate reuses the helper).
+  void broadcast_message(const Message& m);
+  // Drain the shard->consensus inbox: parsed messages into the replica,
+  // gateway link lifecycle into the route tables.
+  void process_shard_inbound();
+  // Fold the shards' relaxed-atomic counters into the (single-writer)
+  // metrics registry as monotonic increments; refresh the gauges.
+  void aggregate_shard_metrics();
   // Chaos link gate: true when the framed bytes should be written to the
   // peer NOW; false when they were dropped (counted) or queued for a
   // delayed release. Called with the final on-wire frame (post-seal), so
@@ -489,6 +533,7 @@ class ReplicaServer {
   // fault / dropped frame tallies surfaced in metrics_json.
   double chaos_drop_pct_ = 0.0;
   int chaos_delay_ms_ = 0;
+  uint64_t chaos_seed_ = 0xC4A05;  // remembered for the per-shard streams
   std::mt19937_64 chaos_rng_{0xC4A05};
   std::map<int64_t,
            std::deque<std::pair<std::chrono::steady_clock::time_point,
@@ -562,6 +607,22 @@ class ReplicaServer {
   std::map<uint64_t, Conn*> gateway_links_;
   std::map<std::string, uint64_t> gateway_routes_;
   uint64_t gateway_link_seq_ = 0;
+  // Multi-core front end (ISSUE 13): created in start() when
+  // cfg_.net_threads > 1. In that mode this class owns NO data sockets —
+  // gateway links live in their shards and are addressed here by the
+  // packed (shard << 48 | conn token) keys below; gateway_routes_ maps
+  // client tokens to those same keys.
+  std::unique_ptr<NetShards> shards_;
+  std::set<uint64_t> sharded_gateways_;
+  // Last-seen shard counter snapshots: shard counters are absolute
+  // relaxed atomics, prometheus counters are monotonic increments.
+  int64_t seen_shard_wakeups_ = 0;
+  int64_t seen_cross_wakes_ = 0;
+  int64_t seen_codec_bin_ = 0;
+  int64_t seen_codec_json_ = 0;
+  int64_t seen_shard_backpressure_ = 0;
+  int64_t seen_shard_chaos_ = 0;
+  int64_t seen_shard_encodes_ = 0;
   int64_t gateway_forwarded_ = 0;  // requests received over gateway links
   // Perf-under-faults surface (ISSUE 12): explicit admission rejections
   // and live gateway links lost mid-run (their clients must fail over).

@@ -7,7 +7,8 @@
 // Usage:
 //   pbftd --config network.json --id 0 --seed <64-hex>
 //         [--verifier cpu|host:port|/unix/path] [--verify-threads N]
-//         [--batch-max-items N] [--batch-flush-us US] [--metrics-every 5]
+//         [--net-threads N] [--batch-max-items N] [--batch-flush-us US]
+//         [--metrics-every 5]
 //         [--fault sig-corrupt|mute|stutter|equivocate]
 //         [--chaos-drop-pct P] [--chaos-delay-ms N] [--chaos-seed S]
 //         [--trace FILE] [--flight-file FILE]
@@ -59,6 +60,9 @@ int main(int argc, char** argv) {
   int verify_threads = 0;  // 0 = hardware_concurrency (the pool default)
   int64_t batch_max_items = -1;  // -1 = keep network.json's value
   int64_t batch_flush_us = -1;
+  // Multi-core replica core (ISSUE 13): event-loop shard threads (each
+  // with a companion crypto pipeline). -1 = keep network.json's value.
+  int64_t net_threads = -1;
   // Fault injection (ISSUE 5): --fault generalizes --byzantine to the
   // full behavior-mode set; --chaos-* are seeded link-level knobs.
   std::string fault_mode_name;
@@ -79,6 +83,7 @@ int main(int argc, char** argv) {
     else if (a == "--verify-threads") verify_threads = std::atoi(next());
     else if (a == "--batch-max-items") batch_max_items = std::atoll(next());
     else if (a == "--batch-flush-us") batch_flush_us = std::atoll(next());
+    else if (a == "--net-threads") net_threads = std::atoll(next());
     else if (a == "--discovery") discovery = next();
     else if (a == "--trace") trace_path = next();
     else if (a == "--flight-file") flight_path = next();
@@ -128,6 +133,7 @@ int main(int argc, char** argv) {
   // and how long a partial batch may wait for more.
   if (batch_max_items >= 1) cfg->batch_max_items = batch_max_items;
   if (batch_flush_us >= 0) cfg->batch_flush_us = batch_flush_us;
+  if (net_threads >= 1) cfg->net_threads = net_threads;
   uint8_t seed[32];
   if (!pbft::from_hex(seed_hex, seed, 32)) {
     std::fprintf(stderr, "bad --seed hex\n");

@@ -34,6 +34,7 @@ std::optional<ClusterConfig> ClusterConfig::from_json_text(
     cfg.admission_inflight = v->as_int();
   if (const Json* v = j->find("admission_backlog"))
     cfg.admission_backlog = v->as_int();
+  if (const Json* v = j->find("net_threads")) cfg.net_threads = v->as_int();
   if (const Json* v = j->find("verifier"); v && v->is_string())
     cfg.verifier = v->as_string();
   if (const Json* v = j->find("secure")) cfg.secure = v->as_bool();

@@ -72,6 +72,12 @@ struct ClusterConfig {
   // dropped; retransmissions always pass. 0 disables either check.
   int64_t admission_inflight = 0;
   int64_t admission_backlog = 0;
+  // Multi-core replica core (ISSUE 13): the number of event-loop shard
+  // threads (each with a companion crypto pipeline thread) the native
+  // runtime runs. 1 = the classic single-threaded loop. The asyncio
+  // runtime accepts the key and stays single-loop (it logs as much);
+  // the default is constants-linted against consensus/config.py.
+  int64_t net_threads = 1;
   std::string verifier = "cpu";  // "cpu" | "host:port" | "/unix/path"
   // Encrypted replica-replica links (core/secure.cc; the reference's
   // development_transport bundles Noise on every link, src/main.rs:42).

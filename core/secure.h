@@ -49,6 +49,17 @@ std::optional<std::string> aead_open(const uint8_t key[64], uint64_t ctr,
                                      const std::string& sealed);
 
 // One connection's handshake state machine + sealed-frame codec.
+//
+// Thread ownership (ISSUE 13): a SecureChannel has exactly ONE owning
+// thread at a time and no internal locking. In the single-loop runtime
+// that is the event-loop thread for the channel's whole life. In the
+// multi-core runtime the owning LOOP SHARD runs the handshake, then
+// MOVES the established channel to its crypto pipeline thread (through
+// the shard->pipeline command queue, which is the synchronization
+// point); from then on every seal_frame/open_frame runs on that one
+// pipeline thread, in command-FIFO order — which is exactly what keeps
+// the per-direction frame counters (the AEAD nonce sequence) in step
+// with the bytes on the wire.
 class SecureChannel {
  public:
   // expected_peer = the dialed replica id (initiator side), or -1 to learn

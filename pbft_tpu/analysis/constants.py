@@ -108,6 +108,11 @@ PAIRS: List[Tuple[str, Tuple[str, str], Tuple[str, str]]] = [
     ("ClusterConfig default: admission_backlog",
      ("core/replica.h", "admission_backlog"),
      ("pbft_tpu/consensus/config.py", "admission_backlog")),
+    # Multi-core replica core (ISSUE 13): a sparse network.json must mean
+    # the classic single-threaded loop in both runtimes.
+    ("ClusterConfig default: net_threads",
+     ("core/replica.h", "net_threads"),
+     ("pbft_tpu/consensus/config.py", "net_threads")),
     # ISSUE 12: forwarded-request retention (view-change re-aim) bound —
     # same eviction point in both runtimes or their storm behavior forks.
     ("forwarded-request retention bound",

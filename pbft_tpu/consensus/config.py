@@ -62,6 +62,13 @@ class ClusterConfig:
     # (liveness must never be admission-gated). 0 disables either check.
     admission_inflight: int = 0
     admission_backlog: int = 0
+    # Multi-core replica core (ISSUE 13): event-loop shard threads (each
+    # with a companion crypto pipeline thread) the NATIVE runtime runs;
+    # 1 = the classic single-threaded loop. The asyncio runtime accepts
+    # the key and stays single-loop (it logs as much at startup) — its
+    # parallelism lives in the JAX mesh, not the socket layer. The
+    # default is constants-linted against core/replica.h.
+    net_threads: int = 1
     verifier: str = "cpu"  # "cpu" | "tpu"
     # Encrypted replica-replica links (signed-ephemeral DH + AEAD framing,
     # pbft_tpu/net/secure.py) — the reference's development_transport
@@ -94,6 +101,7 @@ class ClusterConfig:
                 "batch_flush_us": self.batch_flush_us,
                 "admission_inflight": self.admission_inflight,
                 "admission_backlog": self.admission_backlog,
+                "net_threads": self.net_threads,
                 "verifier": self.verifier,
                 "secure": self.secure,
                 "replicas": [dataclasses.asdict(r) for r in self.replicas],
@@ -115,6 +123,7 @@ class ClusterConfig:
             batch_flush_us=d.get("batch_flush_us", 0),
             admission_inflight=d.get("admission_inflight", 0),
             admission_backlog=d.get("admission_backlog", 0),
+            net_threads=d.get("net_threads", 1),
             verifier=d.get("verifier", "cpu"),
             secure=bool(d.get("secure", False)),
         )

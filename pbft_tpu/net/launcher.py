@@ -71,6 +71,7 @@ class LocalCluster:
         chaos_seed: Optional[int] = None,
         admission_inflight: int = 0,
         admission_backlog: int = 0,
+        net_threads: int = 1,
     ):
         self.trace_dir = trace_dir
         # Black-box flight recorders (ISSUE 9): each daemon dumps its last
@@ -135,6 +136,10 @@ class LocalCluster:
                 # identically by both runtimes.
                 admission_inflight=admission_inflight,
                 admission_backlog=admission_backlog,
+                # Multi-core replica core (ISSUE 13): pbftd shards its
+                # event loop; the asyncio runtime accepts the key and
+                # stays single-loop.
+                net_threads=net_threads,
             )
         self.config = config
         self.seeds = seeds

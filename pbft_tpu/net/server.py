@@ -408,6 +408,28 @@ class AsyncReplicaServer:
             self._on_connection, host="0.0.0.0", port=ident.port
         )
         self.listen_port = self._server.sockets[0].getsockname()[1]
+        # Multi-core key (ISSUE 13): pbftd shards its event loop across
+        # net_threads; this runtime is one asyncio loop by design — accept
+        # the network.json key, say so, and expose the gauge as 1 so a
+        # mixed-runtime scrape attributes per-replica loop counts
+        # honestly. The offload-depth gauge and cross-thread-wake counter
+        # exist for series-set parity (no crypto pipelines here: both
+        # stay 0).
+        if self.config.net_threads > 1:
+            print(
+                f"async replica {self.id}: net_threads="
+                f"{self.config.net_threads} requested; asyncio runtime is "
+                "single-loop (key accepted, sharding is pbftd-only)",
+                flush=True,
+            )
+        if self.metrics_registry.enabled:
+            self.metrics_registry.gauge("pbft_net_loop_threads").set(1)
+            self.metrics_registry.gauge(
+                "pbft_crypto_offload_queue_depth"
+            ).set(0)
+            self.metrics_registry.counter(
+                "pbft_cross_thread_wakes_total"
+            ).inc(0)
         if self.discovery_target:
             from .discovery import Discovery
 
@@ -1433,8 +1455,12 @@ class AsyncReplicaServer:
             "codec_binary_frames": self.codec_binary_frames,
             "codec_json_frames": self.codec_json_frames,
             # Scale-out surface (ISSUE 10; parity with core/net.cc
-            # metrics_json).
+            # metrics_json). net_threads reports 1: the asyncio runtime
+            # is single-loop whatever the config asked for (ISSUE 13).
             "net_backend": "asyncio",
+            "net_threads": 1,
+            "cross_thread_wakes": 0,
+            "crypto_offload_queue_depth": 0,
             "connections_open": max(0, self._conns_open)
             + len(self._peer_links),
             "event_wakeups": self.event_wakeups,

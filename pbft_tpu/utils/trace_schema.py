@@ -226,6 +226,17 @@ METRIC_SCHEMAS = {
     # re-dialing a dead replica link (ClientGateway), or a replica losing
     # a live gateway link (both runtimes).
     "pbft_view_timer_backoff_level": ("gauge", {"server.py", "net.cc"}),
+    # Multi-core surface (ISSUE 13). Loop threads: event-loop shards the
+    # replica runs (pbftd net_threads; always 1 on the single-loop
+    # asyncio runtime). Offload depth: aggregate occupancy of the
+    # per-shard crypto-pipeline queues (AEAD seal/open + codec work held
+    # off the loop threads). Cross-thread wakes: eventfd/pipe wakes
+    # crossing the loop-shard / crypto-pipeline / consensus boundaries —
+    # the handoff cost the sharding pays for its parallelism. The asyncio
+    # runtime emits the latter two as zeros for series-set parity.
+    "pbft_net_loop_threads": ("gauge", {"server.py", "net.cc"}),
+    "pbft_crypto_offload_queue_depth": ("gauge", {"server.py", "net.cc"}),
+    "pbft_cross_thread_wakes_total": ("counter", {"server.py", "net.cc"}),
     "pbft_overload_rejections_total": (
         "counter",
         {"gateway.py", "server.py", "net.cc"},

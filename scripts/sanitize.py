@@ -48,7 +48,7 @@ BUILD_ROOT = REPO / "build-core-san"
 LIB_SOURCES = [
     "blake2b.cc", "sha512.cc", "ed25519.cc", "json.cc", "messages.cc",
     "metrics.cc", "flight.cc", "replica.cc", "verifier.cc", "verify_pool.cc",
-    "secure.cc", "net.cc", "discovery.cc",
+    "secure.cc", "net.cc", "net_shard.cc", "discovery.cc",
 ]
 BINARIES = {
     "core_test": "core_test.cc",

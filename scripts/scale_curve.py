@@ -214,6 +214,7 @@ def run_point(
     impl: str,
     gateways: int,
     deadline_s: float,
+    net_threads: int = 1,
 ) -> dict:
     """One sustained point on the curve: an n-replica cluster, a gateway
     tier in front, ``clients`` concurrent identities through it."""
@@ -229,6 +230,7 @@ def run_point(
         impl=impl,
         batch_max_items=batch,
         batch_flush_us=batch_flush_us,
+        net_threads=net_threads,
     ) as cluster:
         cfg_path = Path(cluster.tmpdir.name) / "network.json"
         gws = []
@@ -281,8 +283,15 @@ def run_point(
             if execd:
                 executed_total += int(execd[-1])
     total = done
+    # The thread count rides in the config field (ISSUE 13): the
+    # net-threads=1 arm keeps the historic key so bench_compare
+    # --group-by config gates it against scale_curve_r10; each
+    # net-threads>1 arm becomes its own group on the per-core curve.
+    config_key = f"scale f={(n - 1) // 3}"
+    if net_threads > 1:
+        config_key += f" t{net_threads}"
     return {
-        "config": f"scale f={(n - 1) // 3}",
+        "config": config_key,
         "replicas": n,
         "f": (n - 1) // 3,
         "clients": clients,
@@ -298,6 +307,7 @@ def run_point(
         "batch_max_items": batch,
         "batch_flush_us": batch_flush_us,
         "window": window,
+        "net_threads": net_threads,
         "gateways": len(gws),
         "verifier": f"gateway-{impl}",
         "completed_pct": round(
@@ -327,6 +337,12 @@ def main() -> int:
     parser.add_argument("--impl", default="cxx", choices=("cxx", "py"),
                         help="replica runtime (default the C++ daemon)")
     parser.add_argument("--gateways", type=int, default=1)
+    parser.add_argument(
+        "--net-threads", type=int, default=1,
+        help="pbftd event-loop shard threads per replica (ISSUE 13); "
+        "rides into the JSONL config field so bench_compare --group-by "
+        "config gates the per-core curve",
+    )
     parser.add_argument("--deadline-s", type=float, default=600.0,
                         help="hard per-point wall-clock bound")
     parser.add_argument("--out", default=None, help="append JSONL here")
@@ -338,6 +354,7 @@ def main() -> int:
         row = run_point(
             n, args.clients, args.requests, args.window, args.batch,
             args.batch_flush_us, args.impl, args.gateways, args.deadline_s,
+            net_threads=args.net_threads,
         )
         print(json.dumps(row), flush=True)
         rows.append(row)
