@@ -166,6 +166,42 @@ size_t pbft_message_from_binary(const uint8_t* payload, size_t payload_len,
   return canon.size();
 }
 
+// MAC-vector frame encode (ISSUE 14; tests/test_wire_codec.py fuzz):
+// the message arrives as a JSON payload, the lanes as n x (rid:u8 ||
+// tag:16B). Returns the frame length (0 when the type has no MAC form).
+size_t pbft_message_to_binary_mac(const uint8_t* payload, size_t payload_len,
+                                  const uint8_t* lanes, size_t n_lanes,
+                                  uint8_t* out, size_t cap) {
+  std::string text((const char*)payload, payload_len);
+  auto msg = pbft::from_payload(text);
+  if (!msg) return 0;
+  std::vector<pbft::MacLane> vec;
+  for (size_t i = 0; i < n_lanes; ++i) {
+    pbft::MacLane lane;
+    lane.rid = lanes[17 * i];
+    std::memcpy(lane.tag, lanes + 17 * i + 1, 16);
+    vec.push_back(lane);
+  }
+  std::string bin;
+  if (!pbft::message_to_binary_mac(*msg, vec, &bin)) return 0;
+  if (bin.size() <= cap) std::memcpy(out, bin.data(), bin.size());
+  return bin.size();
+}
+
+// Lane extraction parity: 1 when the payload is a MAC frame carrying a
+// lane for rid (tag copied out), 0 otherwise.
+int pbft_mac_frame_lane(const uint8_t* payload, size_t payload_len,
+                        long long rid, uint8_t out_tag[16]) {
+  std::string text((const char*)payload, payload_len);
+  return pbft::mac_frame_lane(text, (int64_t)rid, out_tag) ? 1 : 0;
+}
+
+// Authenticator tag parity (net/secure.py mac_tag).
+void pbft_mac_tag(const uint8_t key[32], const uint8_t signable[32],
+                  uint8_t out_tag[16]) {
+  pbft::mac_tag(key, signable, out_tag);
+}
+
 // Signable digest derived from a framed payload (JSON sig-splice or
 // binary template) — the Python parity test compares this against the
 // parse -> re-serialize derivation for every message type. Returns 1 on

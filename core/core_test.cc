@@ -857,7 +857,8 @@ void test_net_backend_parity() {
 // must drive a real-socket 4-replica cluster to the SAME executed state
 // as the classic single loop. Two sequential requests per arm; returns
 // the cluster-wide max executed_upto after a clean stop.
-int64_t multicore_round(int net_threads) {
+int64_t multicore_round(int net_threads, bool fastpath_mac = false,
+                        bool tentative = false) {
   int ports[4];
   int hold[4];
   for (int i = 0; i < 4; ++i) {
@@ -866,6 +867,8 @@ int64_t multicore_round(int net_threads) {
   }
   pbft::ClusterConfig cfg;
   cfg.net_threads = net_threads;
+  if (fastpath_mac) cfg.fastpath = "mac";
+  cfg.tentative = tentative;
   std::vector<std::vector<uint8_t>> seeds;
   for (int i = 0; i < 4; ++i) {
     std::vector<uint8_t> seed(32, (uint8_t)(i + 73));
@@ -933,6 +936,15 @@ int64_t multicore_round(int net_threads) {
   for (auto& s : servers) {
     max_executed = std::max(max_executed, s->replica().executed_upto());
     CHECK(s->replica().executed_upto() >= 1);
+    if (fastpath_mac) {
+      // The fast path actually carried the round: MAC-accepted frames
+      // dispatched without the verify queue on every replica.
+      CHECK(s->replica().counters["mac_verified"] > 0);
+    }
+    if (tentative) {
+      // Commits promoted every tentative execution: the floor caught up.
+      CHECK(s->replica().committed_upto() == s->replica().executed_upto());
+    }
   }
   ::close(reply_fd);
   return max_executed;
@@ -947,6 +959,81 @@ void test_multicore_parity() {
   CHECK(e1 == 2);
   CHECK(e2 == e1);
   CHECK(e4 == e1);
+}
+
+// ISSUE 14: MAC-vector codec units + the authenticator/tentative mode
+// on a real-socket cluster — single loop AND the sharded front end —
+// must reach the same executed state as signature mode.
+void test_mac_codec_native() {
+  pbft::Prepare p;
+  p.view = 3;
+  p.seq = 9;
+  p.digest = std::string(64, 'a');
+  p.replica = 2;
+  p.sig = std::string(128, 'c');
+  std::vector<pbft::MacLane> lanes(2);
+  lanes[0].rid = 0;
+  lanes[1].rid = 3;
+  for (int i = 0; i < 16; ++i) lanes[1].tag[i] = (uint8_t)i;
+  std::string frame;
+  CHECK(pbft::message_to_binary_mac(pbft::Message(p), lanes, &frame));
+  CHECK(pbft::payload_is_mac_frame(frame));
+  auto back = pbft::message_from_binary(frame);
+  CHECK(back.has_value());
+  CHECK(pbft::message_canonical(*back) ==
+        pbft::message_canonical(pbft::Message(p)));
+  uint8_t tag[16];
+  CHECK(pbft::mac_frame_lane(frame, 3, tag));
+  CHECK(tag[5] == 5);
+  CHECK(!pbft::mac_frame_lane(frame, 7, tag));  // no lane: sig fallback
+  // malformed vectors reject
+  CHECK(!pbft::message_from_binary(frame.substr(0, frame.size() - 2))
+             .has_value());
+  std::string bad = frame;
+  bad.back() = (char)77;  // count past the bound
+  CHECK(!pbft::message_from_binary(bad).has_value());
+  // lane tag parity with the keyed primitive
+  uint8_t key[32] = {0};
+  uint8_t signable[32] = {0};
+  uint8_t t1[16], t2[16];
+  pbft::mac_tag(key, signable, t1);
+  pbft::mac_tag(key, signable, t2);
+  CHECK(pbft::mac_tag_equal(t1, t2));
+  t2[0] ^= 1;
+  CHECK(!pbft::mac_tag_equal(t1, t2));
+  // tentative reply flag: omitted when 0 (byte-compat), signed when 1
+  pbft::ClientReply r0;
+  r0.view = 0;
+  r0.timestamp = 1;
+  r0.client = "c";
+  r0.replica = 0;
+  r0.result = "x";
+  r0.sig = std::string(128, 'a');
+  pbft::ClientReply r1 = r0;
+  r1.tentative = 1;
+  const std::string c0 = pbft::message_canonical(pbft::Message(r0));
+  const std::string c1 = pbft::message_canonical(pbft::Message(r1));
+  CHECK(c0.find("tentative") == std::string::npos);
+  CHECK(c1.find("\"tentative\":1") != std::string::npos);
+  uint8_t d0[32], d1[32];
+  pbft::message_signable(pbft::Message(r0), d0);
+  pbft::message_signable(pbft::Message(r1), d1);
+  CHECK(std::memcmp(d0, d1, 32) != 0);  // the flag is signed content
+  auto rt = pbft::from_payload(c1);
+  CHECK(rt.has_value() && std::get<pbft::ClientReply>(*rt).tentative == 1);
+}
+
+void test_fastpath_mac_parity() {
+  const int64_t sig = multicore_round(1, /*fastpath_mac=*/false);
+  const int64_t mac1 =
+      multicore_round(1, /*fastpath_mac=*/true, /*tentative=*/true);
+  const int64_t mac2 =
+      multicore_round(2, /*fastpath_mac=*/true, /*tentative=*/true);
+  // The fast path changes how frames authenticate and when replies
+  // leave, never what the cluster decides.
+  CHECK(sig == 2);
+  CHECK(mac1 == sig);
+  CHECK(mac2 == sig);
 }
 
 void test_flight_recorder() {
@@ -1008,6 +1095,8 @@ int main() {
   test_remote_verifier_readiness();
   test_net_backend_parity();
   test_multicore_parity();
+  test_mac_codec_native();
+  test_fastpath_mac_parity();
   test_flight_recorder();
   if (g_failures) {
     std::fprintf(stderr, "%d failure(s)\n", g_failures);

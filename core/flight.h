@@ -40,6 +40,11 @@ enum FlightEvent : uint16_t {
   kFlightBackoffLevel = 12,
   kFlightOverloadRejected = 13,
   kFlightGatewayFailover = 14,
+  // Fast-path coverage (ISSUE 14): a reply left at PREPARED (seq = the
+  // request timestamp) and a tentative-suffix rollback on view change /
+  // certified-checkpoint catch-up (seq = sequences rolled back).
+  kFlightTentativeReply = 15,
+  kFlightTentativeRollback = 16,
 };
 
 struct FlightRecord {

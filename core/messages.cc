@@ -55,6 +55,9 @@ Json ClientReply::to_json() const {
   o.emplace("replica", replica);
   o.emplace("result", result);
   o.emplace("sig", sig);
+  // Omitted when 0 (the committed case): canonical bytes stay identical
+  // to pre-1.3.0 replies, so old clients keep verifying them.
+  if (tentative) o.emplace(kTentativeField, tentative);
   o.emplace("timestamp", timestamp);
   o.emplace("type", "client-reply");
   o.emplace("view", view);
@@ -380,6 +383,7 @@ std::optional<Message> message_from_json(const Json& j) {
         !get_str(j, "client", &r.client) || !get_int(j, "replica", &r.replica) ||
         !get_str(j, "result", &r.result) || !get_str(j, "sig", &r.sig))
       return std::nullopt;
+    get_int(j, kTentativeField, &r.tentative);  // optional; absent = 0
     return Message(std::move(r));
   }
   if (type == "pre-prepare") {
@@ -477,9 +481,40 @@ enum : uint8_t {
   // Batched pre-prepare (ISSUE 4): 0x02 header + u32 count + requests.
   // Batches of one MUST use 0x02 (one canonical form per message).
   kBinPrePrepareBatch = 0x06,
+  // MAC-vector authenticated variants (ISSUE 14; layout in messages.h).
+  kBinPrePrepareMac = 0x12,
+  kBinPrepareMac = 0x13,
+  kBinCommitMac = 0x14,
+  kBinCheckpointMac = 0x15,
+  kBinPrePrepareBatchMac = 0x16,
 };
 
 constexpr uint32_t kBinMaxBatch = 1u << 16;
+constexpr uint32_t kMacVectorMax = 64;
+
+// mac code -> the base (signature-variant) code it wraps; 0 = not a
+// MAC code.
+uint8_t mac_base_code(uint8_t code) {
+  switch (code) {
+    case kBinPrePrepareMac: return kBinPrePrepare;
+    case kBinPrepareMac: return kBinPrepare;
+    case kBinCommitMac: return kBinCommit;
+    case kBinCheckpointMac: return kBinCheckpoint;
+    case kBinPrePrepareBatchMac: return kBinPrePrepareBatch;
+    default: return 0;
+  }
+}
+
+uint8_t mac_code_of(uint8_t base) {
+  switch (base) {
+    case kBinPrePrepare: return kBinPrePrepareMac;
+    case kBinPrepare: return kBinPrepareMac;
+    case kBinCommit: return kBinCommitMac;
+    case kBinCheckpoint: return kBinCheckpointMac;
+    case kBinPrePrepareBatch: return kBinPrePrepareBatchMac;
+    default: return 0;
+  }
+}
 
 void put_i64(std::string* o, int64_t v) {
   uint64_t u = (uint64_t)v;
@@ -596,7 +631,75 @@ bool message_to_binary(const Message& m, std::string* out) {
   return true;
 }
 
-std::optional<Message> message_from_binary(const std::string& payload) {
+bool message_to_binary_mac(const Message& m, const std::vector<MacLane>& lanes,
+                           std::string* out) {
+  std::string base;
+  if (!message_to_binary(m, &base)) return false;
+  uint8_t mac_code = mac_code_of((uint8_t)base[1]);
+  if (mac_code == 0) return false;
+  if (lanes.empty() || lanes.size() > kMacVectorMax) return false;
+  for (const MacLane& lane : lanes) {
+    if (lane.rid < 0 || lane.rid > 0xFF) return false;
+  }
+  std::string b;
+  b.reserve(base.size() + 17 * lanes.size() + 1);
+  b = base;
+  b[1] = (char)mac_code;
+  for (const MacLane& lane : lanes) {
+    b.push_back((char)(uint8_t)lane.rid);
+    b.append((const char*)lane.tag, 16);
+  }
+  b.push_back((char)(uint8_t)lanes.size());
+  *out = std::move(b);
+  return true;
+}
+
+bool payload_is_mac_frame(const std::string& payload) {
+  return payload.size() >= 2 && (uint8_t)payload[0] == kBinaryMagic &&
+         mac_base_code((uint8_t)payload[1]) != 0;
+}
+
+int64_t mac_claimed_replica(const Message& m) {
+  if (auto* pp = std::get_if<PrePrepare>(&m)) return pp->replica;
+  if (auto* p = std::get_if<Prepare>(&m)) return p->replica;
+  if (auto* c = std::get_if<Commit>(&m)) return c->replica;
+  if (auto* cp = std::get_if<Checkpoint>(&m)) return cp->replica;
+  return -1;
+}
+
+bool mac_frame_lane(const std::string& payload, int64_t rid,
+                    uint8_t out_tag[16]) {
+  if (!payload_is_mac_frame(payload)) return false;
+  uint32_t count = (uint8_t)payload.back();
+  if (count < 1 || count > kMacVectorMax) return false;
+  if (payload.size() < 2 + 17u * count + 1) return false;
+  size_t start = payload.size() - 1 - 17u * count;
+  for (uint32_t k = 0; k < count; ++k) {
+    size_t off = start + 17u * k;
+    if ((uint8_t)payload[off] == (uint8_t)rid && rid >= 0 && rid <= 0xFF) {
+      std::memcpy(out_tag, payload.data() + off + 1, 16);
+      return true;
+    }
+  }
+  return false;
+}
+
+std::optional<Message> message_from_binary(const std::string& payload_in) {
+  // MAC frame variants decode to the same Message as their signature
+  // twins: validate and strip the trailing lane vector, rewrite the
+  // code byte, and fall through to the base parser (the net layer
+  // verifies the lane cryptographically — it holds the link keys).
+  std::string stripped;
+  const std::string* pp = &payload_in;
+  if (payload_is_mac_frame(payload_in)) {
+    uint32_t count = (uint8_t)payload_in.back();
+    if (count < 1 || count > kMacVectorMax) return std::nullopt;
+    if (payload_in.size() < 2 + 17u * count + 1) return std::nullopt;
+    stripped = payload_in.substr(0, payload_in.size() - 1 - 17u * count);
+    stripped[1] = (char)mac_base_code((uint8_t)payload_in[1]);
+    pp = &stripped;
+  }
+  const std::string& payload = *pp;
   if (payload.size() < 2 || (uint8_t)payload[0] != kBinaryMagic) {
     return std::nullopt;
   }

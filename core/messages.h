@@ -42,6 +42,11 @@ struct ClientRequest {
   std::string digest_hex() const;
 };
 
+// The tentative-reply flag's JSON member name (ISSUE 14; mirrors
+// messages.py TENTATIVE_FIELD, constants lint). Omitted when zero so
+// committed replies stay byte-identical to pre-1.3.0 peers.
+inline constexpr const char* kTentativeField = "tentative";
+
 struct ClientReply {
   int64_t view = 0;
   int64_t timestamp = 0;
@@ -49,6 +54,11 @@ struct ClientReply {
   int64_t replica = 0;
   std::string result;
   std::string sig;  // hex; §4.1 reply votes must prove their caster
+  // 1 = executed at *prepared* (tentative, ISSUE 14): the client needs
+  // 2f+1 matching tentative votes instead of f+1 committed ones. Signed
+  // content (a forgeable flag could upgrade tentative votes); omitted
+  // from the canonical encoding when 0.
+  int64_t tentative = 0;
 
   Json to_json() const;
 };
@@ -194,11 +204,51 @@ std::optional<Message> message_from_json(const Json& j);
 inline constexpr uint8_t kBinaryMagic = 0xB2;
 inline constexpr const char* kCodecBinary2 = "bin2";
 
+// MAC-vector authenticated frame variants (ISSUE 14, protocol 1.3.0;
+// byte-identical to messages.py — the constants lint pins the codes):
+//
+//   0xB2 | mac_code | <base fields, sig included> |
+//       count x (rid:u8 | tag:16B) | count:u8
+//
+// The base fields are exactly the signature variant's (the signature
+// rides along as view-change evidence; MAC mode removes its hot-path
+// VERIFICATION); each lane is a 16-byte keyed-BLAKE2b tag under the
+// (sender, receiver) link session key, so one payload fans out
+// serialize-once and each receiver checks only its own lane. The count
+// byte sits last for O(count) lane lookup from the tail.
+//
+//   0x12 pre-prepare (MAC)          wraps 0x02
+//   0x13 prepare (MAC)              wraps 0x03
+//   0x14 commit (MAC)               wraps 0x04
+//   0x15 checkpoint (MAC)           wraps 0x05
+//   0x16 pre-prepare batched (MAC)  wraps 0x06
+struct MacLane {
+  int64_t rid = 0;
+  uint8_t tag[16] = {0};
+};
+
 // Encodes the hot normal-case types; returns false (out untouched) for
 // any other type, or when a digest/sig field is not the fixed-width hex
 // the layout requires — the caller falls back to the JSON codec.
 bool message_to_binary(const Message& m, std::string* out);
 std::optional<Message> message_from_binary(const std::string& payload);
+
+// MAC-vector frame: the signature-variant fields + one lane per entry.
+// False when the message has no binary form, lanes are empty/over the
+// bound, or a lane id is out of u8 range.
+bool message_to_binary_mac(const Message& m, const std::vector<MacLane>& lanes,
+                           std::string* out);
+// True when the payload is one of the MAC frame variants above.
+bool payload_is_mac_frame(const std::string& payload);
+// This receiver's lane tag from a MAC frame's vector; false when absent
+// (not a MAC frame, malformed vector, or no lane for rid — the caller
+// falls back to the signature path the embedded sig still serves).
+bool mac_frame_lane(const std::string& payload, int64_t rid,
+                    uint8_t out_tag[16]);
+// Claimed sender of a hot (MAC-frameable) message; -1 for other types.
+// MAC acceptance must pin this to the link's authenticated peer — the
+// lane proves the LINK, the signature it replaces proved the id.
+int64_t mac_claimed_replica(const Message& m);
 
 // Signable digest straight from a received framed payload: canonical JSON
 // payloads splice out the top-level "sig" member and hash the remaining

@@ -48,6 +48,10 @@ const char* kCounterNames[] = {
     // Multi-core surface (ISSUE 13): eventfd/pipe wakes crossing the
     // loop-shard / crypto-pipeline / consensus thread boundaries.
     "pbft_cross_thread_wakes_total",
+    // Fast-path surface (ISSUE 14): MAC-vector authenticated frames
+    // sent, sequences executed at PREPARED, tentative rollbacks.
+    "pbft_mac_frames_total", "pbft_tentative_executions_total",
+    "pbft_tentative_rollbacks_total",
 };
 const char* kGaugeNames[] = {
     "pbft_verify_queue_depth",

@@ -237,6 +237,32 @@ size_t max_send_block() { return kMaxSendBlock; }
 
 const char* ReplicaServer::net_backend() const { return poller_->name(); }
 
+// The shared MAC-vector frame for a broadcast (ISSUE 14): one lane per
+// dest in the sender's key table, all over one signable digest. Defined
+// here (not net.h) so the header stays crypto-free.
+const std::string* EncodedOut::mac_payload(
+    const std::map<int64_t, std::array<uint8_t, 32>>& keys) {
+  if (!mac_tried) {
+    mac_tried = true;
+    if (!keys.empty()) {
+      uint8_t signable[32];
+      message_signable(*m, signable);
+      std::vector<MacLane> lanes;
+      lanes.reserve(keys.size());
+      for (const auto& [rid, key] : keys) {  // std::map: sorted lanes
+        MacLane lane;
+        lane.rid = rid;
+        mac_tag(key.data(), signable, lane.tag);
+        lanes.push_back(lane);
+      }
+      mac_ok = message_to_binary_mac(*m, lanes, &mac);
+      if (mac_ok) ++encodes;
+    }
+  }
+  return mac_ok ? &mac : nullptr;
+}
+
+
 bool fault_mode_from_string(const std::string& s, FaultMode* out) {
   if (s.empty() || s == "none") *out = FaultMode::kNone;
   else if (s == "sig-corrupt" || s == "byzantine") *out = FaultMode::kSigCorrupt;
@@ -260,6 +286,8 @@ ReplicaServer::ReplicaServer(ClusterConfig cfg, int64_t id,
                              std::unique_ptr<Verifier> verifier)
     : cfg_(cfg), id_(id), verifier_(std::move(verifier)) {
   std::memcpy(seed_, seed, 32);
+  // Fast-path offer (ISSUE 14): config asks, the env levers may cap it.
+  fastpath_mac_ = wire_offer_mac(cfg_.fastpath == "mac");
   // Readiness backend before any conn can exist: every accept/dial path
   // registers with the poller unconditionally.
   poller_ = make_poller();
@@ -570,6 +598,10 @@ void ReplicaServer::process_shard_inbound() {
         trace_request_rx(*req);
         emit(replica_->receive(*k.msg));
       }
+    } else if (k.pre_authenticated) {
+      // The pipeline verified this frame's MAC lane (ISSUE 14): no
+      // verify queue, straight dispatch.
+      emit(replica_->receive_authenticated(*k.msg));
     } else if (k.has_signable) {
       emit(replica_->receive(*k.msg, k.signable));
     } else {
@@ -594,6 +626,7 @@ void ReplicaServer::aggregate_shard_metrics() {
         "pbft_codec_binary_frames_total");
   delta(shards_->codec_json_frames(), &seen_codec_json_,
         "pbft_codec_json_frames_total");
+  delta(shards_->mac_frames(), &seen_shard_mac_, "pbft_mac_frames_total");
   delta(shards_->backpressure_events(), &seen_shard_backpressure_,
         "pbft_write_backpressure_events_total");
   delta(shards_->chaos_dropped(), &seen_shard_chaos_,
@@ -815,16 +848,54 @@ bool ReplicaServer::handle_peer_frame(Conn& c, std::string payload) {
     if (c.chan && !c.chan->established()) {
       auto j = Json::parse(payload);
       if (!j) return fail_conn(c, "malformed handshake reply");
+      if (c.chan->auth_only()) {
+        // Authenticator mode on a plaintext cluster: a responder that
+        // answered the mac-offering hello with a classic hello-ack
+        // (pre-1.3.0 or signature-mode config) downgrades this link to
+        // the plain flavor — its ack still carried the codec offer.
+        const Json* t = j->find("type");
+        if (t && t->is_string() && t->as_string() == "reject") {
+          const Json* reason = j->find("reason");
+          return fail_conn(c, "peer rejected link: " +
+                                  (reason && reason->is_string()
+                                       ? reason->as_string()
+                                       : "<no reason>"));
+        }
+        const Json* eph = j->find("eph");
+        if (!eph || !eph->is_string()) {
+          c.chan.reset();
+          if (t && t->is_string() && t->as_string() == "hello") {
+            c.codec_binary = hello_offers_binary(*j);
+          }
+          for (auto& p : c.pending) queue_bytes(c, frame_payload(p));
+          c.pending.clear();
+          flush(c);
+          return !c.closed;
+        }
+      }
       auto auth = c.chan->on_hello_reply(*j);
       if (!auth) return fail_conn(c, c.chan->error());
       // hello_r carries the responder's codec offer: binary-v2 from here
       // on when both sides speak it (sends queued pre-handshake were
       // already JSON-encoded; mixed frames on one link are fine — the
-      // receiver detects the codec per frame).
+      // receiver detects the codec per frame). The mac offer rides the
+      // same frame: a mutually-offered link registers its sender-side
+      // lane key so broadcasts grow a lane for this peer.
       c.codec_binary = hello_offers_binary(*j);
+      if (c.chan->mac_negotiated()) {
+        c.mac_ready = true;
+        std::array<uint8_t, 32> key;
+        std::memcpy(key.data(), c.chan->auth_send_key(), 32);
+        mac_send_keys_[c.peer_dest] = key;
+      } else {
+        mac_send_keys_.erase(c.peer_dest);
+      }
+      const bool auth_only = c.chan->auth_only();
       queue_bytes(c, frame_payload(*auth));
-      for (auto& p : c.pending)
-        queue_bytes(c, frame_payload(c.chan->seal_frame(p)));
+      for (auto& p : c.pending) {
+        queue_bytes(
+            c, frame_payload(auth_only ? p : c.chan->seal_frame(p)));
+      }
       c.pending.clear();
       flush(c);
       return !c.closed;
@@ -843,9 +914,11 @@ bool ReplicaServer::handle_peer_frame(Conn& c, std::string payload) {
       }
       return true;
     }
-    auto pt = c.chan->open_frame(payload);
-    if (!pt) return fail_conn(c, c.chan->error());
-    payload = std::move(*pt);
+    if (c.chan && !c.chan->auth_only()) {
+      auto pt = c.chan->open_frame(payload);
+      if (!pt) return fail_conn(c, c.chan->error());
+      payload = std::move(*pt);
+    }
   } else if (!c.hello_seen) {
     // Accepted link: the first frame carries the protocol version.
     auto j = Json::parse(payload);
@@ -855,6 +928,7 @@ bool ReplicaServer::handle_peer_frame(Conn& c, std::string payload) {
       std::string err;
       if (!SecureChannel::check_version(*j, &err)) return reject_conn(c, err);
       c.hello_seen = true;
+      c.peer_mac = fastpath_mac_ && hello_offers_mac(*j);
       // Gateway trust (ISSUE 10): a hello carrying role=gateway marks
       // this link as a client-gateway — framed client requests arrive on
       // it, and replies for those clients fan BACK over it instead of
@@ -871,18 +945,37 @@ bool ReplicaServer::handle_peer_frame(Conn& c, std::string payload) {
         c.link_id = ++gateway_link_seq_;
         gateway_links_[c.link_id] = &c;
       }
+      const Json* eph = j->find("eph");
       if (cfg_.secure) {
         c.chan = std::make_unique<SecureChannel>(&cfg_, id_, seed_,
-                                                 /*initiator=*/false);
+                                                 /*initiator=*/false,
+                                                 /*expected_peer=*/-1,
+                                                 fastpath_mac_);
+        auto reply = c.chan->on_hello(*j);
+        if (!reply) return reject_conn(c, c.chan->error());
+        queue_bytes(c, frame_payload(*reply));
+        flush(c);
+      } else if (c.peer_mac && eph && eph->is_string()) {
+        // Authenticator mode on a plaintext cluster (ISSUE 14): the
+        // SAME signed station-to-station handshake runs purely for
+        // lane-key agreement + peer identity — frames after it stay
+        // plaintext (auth-only channel, never sealed/opened).
+        c.chan = std::make_unique<SecureChannel>(&cfg_, id_, seed_,
+                                                 /*initiator=*/false,
+                                                 /*expected_peer=*/-1,
+                                                 fastpath_mac_,
+                                                 /*auth_only=*/true);
         auto reply = c.chan->on_hello(*j);
         if (!reply) return reject_conn(c, c.chan->error());
         queue_bytes(c, frame_payload(*reply));
         flush(c);
       } else {
         // Plaintext hello-ack: advertise this node's version + codec
-        // offer so the dialing peer can negotiate binary-v2 (a 1.0.0
-        // initiator parses and ignores any non-reject frame).
-        queue_bytes(c, frame_payload(SecureChannel::plain_hello(id_)));
+        // (and fast-path) offers so the dialing peer can negotiate
+        // binary-v2 / mac (a 1.0.0 initiator parses and ignores any
+        // non-reject frame).
+        queue_bytes(c, frame_payload(
+                           SecureChannel::plain_hello(id_, fastpath_mac_)));
         flush(c);
       }
       return !c.closed;
@@ -899,14 +992,40 @@ bool ReplicaServer::handle_peer_frame(Conn& c, std::string payload) {
       return reject_conn(c, c.chan->error().empty() ? "malformed auth frame"
                                                     : c.chan->error());
     }
+    // Established: an inbound mac-negotiated link verifies lanes with
+    // the channel's recv key from here on.
+    if (c.chan->mac_negotiated()) c.mac_ready = true;
     return true;
-  } else if (c.chan) {
+  } else if (c.chan && !c.chan->auth_only()) {
     auto pt = c.chan->open_frame(payload);
     if (!pt) return fail_conn(c, c.chan->error());
     payload = std::move(*pt);
   }
   auto msg = from_payload(payload);
   if (msg) {
+    // Authenticator fast path (ISSUE 14): a MAC frame on a
+    // mac-negotiated link verifies THIS replica's lane + the claimed
+    // sender against the link's authenticated peer, then dispatches
+    // WITHOUT the verify queue. No lane for us (link joined
+    // mid-fan-out) falls through to the signature path the embedded
+    // sig still serves; a lane MISMATCH drops and counts.
+    if (c.mac_ready && c.chan && payload_is_mac_frame(payload)) {
+      uint8_t lane[16];
+      if (mac_frame_lane(payload, id_, lane)) {
+        uint8_t signable[32], want[16];
+        message_signable_from_payload(payload, *msg, signable);
+        mac_tag(c.chan->auth_recv_key(), signable, want);
+        if (!mac_tag_equal(lane, want) ||
+            mac_claimed_replica(*msg) != c.chan->peer_id()) {
+          ++mac_rejected_;
+          return true;
+        }
+        ++frames_in_;
+        metrics_.inc("pbft_frames_in_total");
+        emit(replica_->receive_authenticated(*msg));
+        return true;
+      }
+    }
     ++frames_in_;
     metrics_.inc("pbft_frames_in_total");
     if (std::holds_alternative<ClientRequest>(*msg)) {
@@ -938,6 +1057,9 @@ bool ReplicaServer::handle_peer_frame(Conn& c, std::string payload) {
 
 void ReplicaServer::mark_closed(Conn& c) {
   if (c.closed) return;
+  // A dialed mac link's lane key dies with the connection (the redial's
+  // handshake derives fresh ones).
+  if (c.peer_dest >= 0 && c.mac_ready) mac_send_keys_.erase(c.peer_dest);
   if (c.fd >= 0) {
     // Deregister BEFORE close: the fallback backend keeps polling a
     // removed fd otherwise (POLLNVAL forever); epoll auto-deregisters on
@@ -1625,13 +1747,38 @@ void ReplicaServer::emit(Actions&& actions) {
       continue;
     }
     trace_reply_tx(r.msg);
+    if (r.msg.tentative) {
+      // Fast-path coverage (ISSUE 14): the reply left at PREPARED, one
+      // commit round-trip early.
+      FlightRecorder& fl = global_flight();
+      if (fl.enabled()) {
+        fl.record(kFlightTentativeReply, r.msg.view, r.msg.timestamp, -1);
+      }
+    }
     dial_reply(r.client, r.msg);
   }
   observe_execution_metrics();
 }
 
 void ReplicaServer::observe_execution_metrics() {
+  // Rollbacks ship to the black box whether or not metrics are on — a
+  // rollback is a rare, load-bearing event (ISSUE 14).
+  const int64_t t_roll = replica_->counters["tentative_rollbacks"];
+  if (t_roll > seen_rollbacks_) {
+    FlightRecorder& fl = global_flight();
+    if (fl.enabled()) {
+      fl.record(kFlightTentativeRollback, replica_->view(),
+                t_roll - seen_rollbacks_, -1);
+    }
+    metrics_.inc("pbft_tentative_rollbacks_total", t_roll - seen_rollbacks_);
+    seen_rollbacks_ = t_roll;
+  }
   if (!metrics_.enabled) return;
+  const int64_t t_exec = replica_->counters["tentative_executions"];
+  if (t_exec > seen_tentative_) {
+    metrics_.inc("pbft_tentative_executions_total", t_exec - seen_tentative_);
+    seen_tentative_ = t_exec;
+  }
   // Deltas of the replica's own counters: "executed" counts per REQUEST,
   // "rounds_executed" per sequence number — the two together are the
   // batching amplification factor (requests per three-phase instance).
@@ -1685,14 +1832,17 @@ void ReplicaServer::check_progress_timer() {
   }
   if (!timer_armed_) {
     timer_armed_ = true;
-    timer_exec_snapshot_ = replica_->executed_upto();
+    // Tentative mode: progress = COMMITTED sequences, so a
+    // commit-starved cluster still escalates (tentative executions roll
+    // back — they must not placate the timer).
+    timer_exec_snapshot_ = replica_->progress_marker();
     timer_view_snapshot_ = replica_->view();
     timer_deadline_ =
         now + std::chrono::milliseconds(vc_timeout_ms_ * timer_backoff_);
     return;
   }
   if (now < timer_deadline_) return;
-  if (replica_->executed_upto() > timer_exec_snapshot_ ||
+  if (replica_->progress_marker() > timer_exec_snapshot_ ||
       replica_->view() > timer_view_snapshot_) {
     // Progress happened; rearm fresh.
     timer_backoff_ = 1;
@@ -1785,11 +1935,16 @@ int ReplicaServer::peer_fd(int64_t dest) {
       std::chrono::steady_clock::now() + std::chrono::seconds(10);
   // Link prologue: every peer link opens with a version-carrying hello;
   // secure clusters start the full handshake (protocol messages queue in
-  // c->pending until it completes).
+  // c->pending until it completes). Authenticator mode on a plaintext
+  // cluster runs the SAME handshake auth-only (lane keys + identity,
+  // frames stay plaintext); an old responder downgrades the link in
+  // handle_peer_frame.
   c->rbuf.data = pool_.acquire();
-  if (cfg_.secure) {
+  if (cfg_.secure || fastpath_mac_) {
     c->chan = std::make_unique<SecureChannel>(&cfg_, id_, seed_,
-                                              /*initiator=*/true, dest);
+                                              /*initiator=*/true, dest,
+                                              fastpath_mac_,
+                                              /*auth_only=*/!cfg_.secure);
     queue_bytes(*c, frame_payload(c->chan->initiator_hello()));
   } else {
     queue_bytes(*c, frame_payload(SecureChannel::plain_hello(id_)));
@@ -1843,19 +1998,30 @@ void ReplicaServer::send_encoded(int64_t dest, EncodedOut& enc) {
   if (peer_fd(dest) < 0) return;  // peer down: PBFT tolerates f of these
   Conn& c = *peers_[dest];
   const std::string* payload = nullptr;
-  if (c.codec_binary) payload = enc.binary_payload();
+  bool mac_frame = false;
+  if (c.mac_ready) {
+    // Authenticator mode: the shared MAC-vector frame — one encode +
+    // one lane set per broadcast, every mac link ships the same bytes.
+    payload = enc.mac_payload(mac_send_keys_);
+    mac_frame = payload != nullptr;
+  }
+  if (payload == nullptr && c.codec_binary) payload = enc.binary_payload();
   const bool bin = payload != nullptr;
   if (!bin) payload = &enc.json_payload();
   metrics_.inc(bin ? "pbft_codec_binary_frames_total"
                    : "pbft_codec_json_frames_total");
-  if (cfg_.secure) {
-    if (!c.chan || !c.chan->established()) {
-      // Handshake in flight: queue (bounded — a wedged handshake must not
-      // buffer without limit; PBFT tolerates the loss via retransmission).
-      if (c.pending.size() < 4096) c.pending.push_back(*payload);
-      flush(c);
-      return;
-    }
+  if (mac_frame) {
+    ++mac_frames_;
+    metrics_.inc("pbft_mac_frames_total");
+  }
+  if (c.chan && !c.chan->established()) {
+    // Handshake in flight: queue (bounded — a wedged handshake must not
+    // buffer without limit; PBFT tolerates the loss via retransmission).
+    if (c.pending.size() < 4096) c.pending.push_back(*payload);
+    flush(c);
+    return;
+  }
+  if (c.chan && !c.chan->auth_only()) {
     // Bounded-outbound admission BEFORE the seal: sealing consumes the
     // link's AEAD nonce, so a post-seal drop would desync the channel —
     // the admission drop must look like the frame was never sealed.
@@ -2177,6 +2343,15 @@ std::string ReplicaServer::metrics_json() const {
   o["chaos_dropped"] =
       Json(chaos_dropped_ + (shards_ ? shards_->chaos_dropped() : 0));
   o["verify_deadline_fired"] = Json(verify_deadline_fired_);
+  // Fast-path surface (ISSUE 14): the negotiated-offer mode, tentative
+  // execution, MAC frame tallies, committed floor.
+  o["mode"] = Json(std::string(fastpath_mac_ ? "mac" : "sig"));
+  o["tentative"] = Json(cfg_.tentative);
+  o["mac_frames"] =
+      Json(mac_frames_ + (shards_ ? shards_->mac_frames() : 0));
+  o["mac_rejected"] =
+      Json(mac_rejected_ + (shards_ ? shards_->mac_rejected() : 0));
+  o["committed_upto"] = Json(replica_->committed_upto());
   o["executed_upto"] = Json(replica_->executed_upto());
   o["low_mark"] = Json(replica_->low_mark());
   o["view"] = Json(replica_->view());

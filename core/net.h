@@ -227,6 +227,13 @@ struct Conn {
   // Frames sent before the offer arrives go as JSON; receivers detect
   // the codec per frame from the payload's first byte.
   bool codec_binary = false;
+  // Fast-path negotiation (ISSUE 14): peer_mac latches when the hello
+  // offered the MAC authenticator mode (and this node offers it);
+  // mac_ready flips once the handshake established the lane keys —
+  // outbound hot messages then go as MAC-vector frames (dialed links)
+  // and inbound MAC frames verify their lane (accepted links).
+  bool peer_mac = false;
+  bool mac_ready = false;
   // Inbound link whose hello carried role=gateway (ISSUE 10): framed
   // client requests arrive here, and replies for the clients it forwarded
   // fan BACK over this same link instead of per-reply dial-backs.
@@ -258,6 +265,14 @@ struct EncodedOut {
   std::string binary;
   bool binary_tried = false;
   bool binary_ok = false;
+  // MAC-vector variant (ISSUE 14): computed AT MOST ONCE per broadcast
+  // over the sender-side lane keys of every mac-negotiated link — the
+  // serialize-once invariant extended to the authenticator mode. A peer
+  // whose link joins mid-fan-out misses its lane and falls back to
+  // signature verification (the sig rides in the frame).
+  std::string mac;
+  bool mac_tried = false;
+  bool mac_ok = false;
   int64_t encodes = 0;
 
   explicit EncodedOut(const Message* msg) : m(msg) {}
@@ -276,6 +291,8 @@ struct EncodedOut {
     }
     return binary_ok ? &binary : nullptr;
   }
+  const std::string* mac_payload(
+      const std::map<int64_t, std::array<uint8_t, 32>>& keys);
 };
 
 // Replica-level Byzantine behavior modes (--fault, ISSUE 5). Mirrors the
@@ -528,6 +545,18 @@ class ReplicaServer {
   int vc_timeout_ms_ = 0;
   bool timer_armed_ = false;
   FaultMode fault_mode_ = FaultMode::kNone;
+  // Fast-path mode (ISSUE 14): whether this node offers the MAC
+  // authenticator mode, the sender-side lane key per mac-negotiated
+  // dialed link (the shared per-broadcast MAC vector reads the whole
+  // table), and the frame tallies.
+  bool fastpath_mac_ = false;
+  std::map<int64_t, std::array<uint8_t, 32>> mac_send_keys_;
+  int64_t mac_frames_ = 0;
+  int64_t mac_rejected_ = 0;
+  // Last-seen tentative counters for the metric deltas + the rollback
+  // flight record.
+  int64_t seen_tentative_ = 0;
+  int64_t seen_rollbacks_ = 0;
   // Chaos link state (set_chaos): seeded drop/delay on outbound peer
   // frames, a per-destination FIFO of delayed frames, and the injected
   // fault / dropped frame tallies surfaced in metrics_json.
@@ -620,6 +649,7 @@ class ReplicaServer {
   int64_t seen_cross_wakes_ = 0;
   int64_t seen_codec_bin_ = 0;
   int64_t seen_codec_json_ = 0;
+  int64_t seen_shard_mac_ = 0;
   int64_t seen_shard_backpressure_ = 0;
   int64_t seen_shard_chaos_ = 0;
   int64_t seen_shard_encodes_ = 0;

@@ -110,6 +110,29 @@ const std::string* ShardEncoded::binary_payload() {
   return bin_ok_ ? &binary_ : nullptr;
 }
 
+const std::string* ShardEncoded::mac_payload(NetShards* owner) {
+  std::lock_guard<std::mutex> lk(mu_);
+  if (!mac_tried_) {
+    mac_tried_ = true;
+    auto keys = owner->mac_key_snapshot();
+    if (!keys.empty()) {
+      uint8_t signable[32];
+      message_signable(m_, signable);
+      std::vector<MacLane> lanes;
+      lanes.reserve(keys.size());
+      for (const auto& [rid, key] : keys) {  // std::map: sorted lanes
+        MacLane lane;
+        lane.rid = rid;
+        mac_tag(key.data(), signable, lane.tag);
+        lanes.push_back(lane);
+      }
+      mac_ok_ = message_to_binary_mac(m_, lanes, &mac_);
+      if (mac_ok_ && tally_) tally_->fetch_add(1, std::memory_order_relaxed);
+    }
+  }
+  return mac_ok_ ? &mac_ : nullptr;
+}
+
 // -- CryptoPipeline ----------------------------------------------------------
 
 void CryptoPipeline::push(CryptoCmd&& c, bool force) {
@@ -184,6 +207,7 @@ void CryptoPipeline::handle(CryptoCmd& c) {
         PeerState& p = peers_[c.dest];
         p.ready = true;
         p.codec_binary = c.codec_binary;
+        p.mac = c.mac;
         p.chan = std::move(c.chan);
         p.out_gauge = std::move(c.out_gauge);
         // Payloads queued while the prologue ran seal in FIFO order —
@@ -194,6 +218,7 @@ void CryptoPipeline::handle(CryptoCmd& c) {
       } else {
         ConnState& s = conns_[c.conn_id];
         s.chan = std::move(c.chan);
+        s.mac = c.mac;
         s.gateway = c.gateway;
         s.out_gauge = std::move(c.out_gauge);
         if (c.gateway) {
@@ -243,11 +268,22 @@ void CryptoPipeline::handle(CryptoCmd& c) {
         return;
       }
       const std::string* payload = nullptr;
-      if (p.codec_binary) payload = c.enc->binary_payload();
+      bool mac_frame = false;
+      if (p.mac) {
+        // Authenticator mode (ISSUE 14): the shared MAC-vector frame —
+        // lanes over the owner's cross-shard key table, computed at
+        // most once per broadcast whichever pipeline gets there first.
+        payload = c.enc->mac_payload(owner_);
+        mac_frame = payload != nullptr;
+      }
+      if (payload == nullptr && p.codec_binary) {
+        payload = c.enc->binary_payload();
+      }
       const bool bin = payload != nullptr;
       if (!bin) payload = &c.enc->json_payload();
       (bin ? bin_frames : json_frames)
           .fetch_add(1, std::memory_order_relaxed);
+      if (mac_frame) mac_frames.fetch_add(1, std::memory_order_relaxed);
       seal_and_ship(c.dest, *payload);
       return;
     }
@@ -293,7 +329,7 @@ void CryptoPipeline::open_and_forward(uint64_t conn_id, int64_t dest,
     chan = it->second.chan.get();
     from_gateway = it->second.gateway;
   }
-  if (chan) {
+  if (chan && !chan->auth_only()) {
     auto pt = chan->open_frame(payload);
     if (!pt) {
       // AEAD failure: the link must drop (same contract as fail_conn).
@@ -311,11 +347,11 @@ void CryptoPipeline::open_and_forward(uint64_t conn_id, int64_t dest,
     }
     payload = std::move(*pt);
   }
-  parse_to_k(conn_id, from_gateway, std::move(payload));
+  parse_to_k(conn_id, from_gateway, std::move(payload), chan);
 }
 
 void CryptoPipeline::parse_to_k(uint64_t conn_id, bool from_gateway,
-                                std::string payload) {
+                                std::string payload, SecureChannel* chan) {
   auto msg = from_payload(payload);
   if (!msg) return;
   KInbound in;
@@ -323,6 +359,29 @@ void CryptoPipeline::parse_to_k(uint64_t conn_id, bool from_gateway,
   in.shard = idx_;
   in.conn_id = conn_id;
   in.from_gateway = from_gateway;
+  // Authenticator fast path (ISSUE 14): a MAC frame on a mac-negotiated
+  // link verifies OUR lane + the claimed sender here, on the pipeline
+  // thread — the consensus thread then dispatches it with no verify
+  // queue. A missing lane falls through to the signature path; a lane
+  // mismatch drops and counts.
+  if (chan && chan->established() && chan->mac_negotiated() &&
+      payload_is_mac_frame(payload)) {
+    uint8_t lane[16];
+    if (mac_frame_lane(payload, owner_->id(), lane)) {
+      uint8_t signable[32], want[16];
+      message_signable_from_payload(payload, *msg, signable);
+      mac_tag(chan->auth_recv_key(), signable, want);
+      if (!mac_tag_equal(lane, want) ||
+          mac_claimed_replica(*msg) != chan->peer_id()) {
+        mac_rejected.fetch_add(1, std::memory_order_relaxed);
+        return;
+      }
+      in.pre_authenticated = true;
+      in.msg = std::move(*msg);
+      owner_->push_inbound(idx_, std::move(in));
+      return;
+    }
+  }
   if (!std::holds_alternative<ClientRequest>(*msg)) {
     // Receive-side canonical reuse, now off the consensus thread: the
     // signable digest derives from the framed bytes we already hold.
@@ -341,7 +400,7 @@ void CryptoPipeline::seal_and_ship(int64_t dest, const std::string& payload) {
   }
   PeerState& p = peers_[dest];
   std::string framed;
-  if (p.chan) {
+  if (p.chan && !p.chan->auth_only()) {
     // Bounded-outbound admission BEFORE the seal: sealing consumes the
     // link's AEAD nonce, so the drop must look like the frame was never
     // sealed (net.cc send_encoded's invariant, held across the offload).
@@ -661,6 +720,7 @@ void NetShard::offload_established(Conn& c, int64_t dest) {
   cmd.dest = dest;
   cmd.chan = std::move(c.chan);
   cmd.codec_binary = c.codec_binary;
+  cmd.mac = c.mac_ready;
   cmd.gateway = c.gateway;
   cmd.out_gauge = c.out_gauge;
   owner_->pipeline(idx_).push(std::move(cmd), /*force=*/true);
@@ -678,12 +738,38 @@ bool NetShard::handle_prologue_frame(Conn& c, std::string payload) {
         mark_closed(c);
         return false;
       }
+      if (c.chan->auth_only()) {
+        // Authenticator mode on a plaintext cluster: an old (or
+        // signature-mode) responder answers with a classic hello-ack —
+        // downgrade this link to the plain flavor (net.cc mirror).
+        const Json* t = j->find("type");
+        if (t && t->is_string() && t->as_string() == "reject") {
+          mark_closed(c);
+          return false;
+        }
+        const Json* eph = j->find("eph");
+        if (!eph || !eph->is_string()) {
+          c.chan.reset();
+          if (t && t->is_string() && t->as_string() == "hello") {
+            c.codec_binary = hello_offers_binary(*j);
+          }
+          offload_established(c, c.peer_dest);
+          return true;
+        }
+      }
       auto auth = c.chan->on_hello_reply(*j);
       if (!auth) {
         mark_closed(c);
         return false;
       }
       c.codec_binary = hello_offers_binary(*j);
+      if (c.chan->mac_negotiated()) {
+        // Register the sender-side lane key in the cross-shard table
+        // BEFORE the channel moves to the pipeline (this thread still
+        // owns it; broadcasts from any pipeline read the table).
+        c.mac_ready = true;
+        owner_->set_mac_key(c.peer_dest, c.chan->auth_send_key());
+      }
       queue_bytes(c, frame_payload(*auth));
       flush(c);
       if (c.closed) return false;
@@ -718,6 +804,7 @@ bool NetShard::handle_prologue_frame(Conn& c, std::string payload) {
       std::string err;
       if (!SecureChannel::check_version(*j, &err)) return reject_conn(c, err);
       c.hello_seen = true;
+      c.peer_mac = owner_->fastpath_mac() && hello_offers_mac(*j);
       const Json* role = j->find("role");
       if (role && role->is_string() && role->as_string() == "gateway") {
         if (cfg.secure) {
@@ -727,17 +814,36 @@ bool NetShard::handle_prologue_frame(Conn& c, std::string payload) {
         }
         c.gateway = true;
       }
+      const Json* eph = j->find("eph");
       if (cfg.secure) {
         c.chan = std::make_unique<SecureChannel>(&cfg, owner_->id(),
                                                  owner_->seed(),
-                                                 /*initiator=*/false);
+                                                 /*initiator=*/false,
+                                                 /*expected_peer=*/-1,
+                                                 owner_->fastpath_mac());
         auto reply = c.chan->on_hello(*j);
         if (!reply) return reject_conn(c, c.chan->error());
         queue_bytes(c, frame_payload(*reply));
         flush(c);
         return !c.closed;
       }
-      queue_bytes(c, frame_payload(SecureChannel::plain_hello(owner_->id())));
+      if (c.peer_mac && eph && eph->is_string()) {
+        // Authenticator mode on a plaintext cluster (ISSUE 14): the
+        // SAME signed handshake, auth-only — frames stay plaintext.
+        c.chan = std::make_unique<SecureChannel>(&cfg, owner_->id(),
+                                                 owner_->seed(),
+                                                 /*initiator=*/false,
+                                                 /*expected_peer=*/-1,
+                                                 owner_->fastpath_mac(),
+                                                 /*auth_only=*/true);
+        auto reply = c.chan->on_hello(*j);
+        if (!reply) return reject_conn(c, c.chan->error());
+        queue_bytes(c, frame_payload(*reply));
+        flush(c);
+        return !c.closed;
+      }
+      queue_bytes(c, frame_payload(SecureChannel::plain_hello(
+                         owner_->id(), owner_->fastpath_mac())));
       flush(c);
       if (c.closed) return false;
       offload_established(c, -1);
@@ -763,6 +869,7 @@ bool NetShard::handle_prologue_frame(Conn& c, std::string payload) {
       return reject_conn(c, c.chan->error().empty() ? "malformed auth frame"
                                                     : c.chan->error());
     }
+    if (c.chan->mac_negotiated()) c.mac_ready = true;
     offload_established(c, -1);
     return true;
   }
@@ -826,6 +933,10 @@ void NetShard::flush(Conn& c) {
 
 void NetShard::mark_closed(Conn& c) {
   if (c.closed) return;
+  // A dialed mac link's lane key dies with the connection.
+  if (c.peer_dest >= 0 && c.mac_ready) {
+    owner_->erase_mac_key(c.peer_dest);
+  }
   if (c.fd >= 0) {
     poller_->remove(c.fd);
     close(c.fd);
@@ -883,10 +994,14 @@ void NetShard::dial_peer(int64_t dest, const std::string& addr) {
   c->rbuf.data = pool_.acquire();
   c->out_gauge = std::make_shared<std::atomic<int64_t>>(0);
   const ClusterConfig& cfg = owner_->cfg();
-  if (cfg.secure) {
-    c->chan = std::make_unique<SecureChannel>(&cfg, owner_->id(),
-                                              owner_->seed(),
-                                              /*initiator=*/true, dest);
+  if (cfg.secure || owner_->fastpath_mac()) {
+    // Authenticator mode on a plaintext cluster runs the SAME signed
+    // handshake auth-only (lane keys + identity; frames stay
+    // plaintext); an old responder downgrades in the prologue.
+    c->chan = std::make_unique<SecureChannel>(
+        &cfg, owner_->id(), owner_->seed(),
+        /*initiator=*/true, dest, owner_->fastpath_mac(),
+        /*auth_only=*/!cfg.secure);
     queue_bytes(*c, frame_payload(c->chan->initiator_hello()));
   } else {
     queue_bytes(*c, frame_payload(SecureChannel::plain_hello(owner_->id())));
@@ -995,6 +1110,7 @@ NetShards::NetShards(const ClusterConfig& cfg, int64_t id,
                      int nshards)
     : cfg_(cfg), id_(id), stopping_(stopping) {
   std::memcpy(seed_, seed, 32);
+  fastpath_mac_ = wire_offer_mac(cfg_.fastpath == "mac");
   nshards = std::max(1, nshards);
   for (int i = 0; i < nshards; ++i) {
     shards_.push_back(std::make_unique<NetShard>(this, i));
@@ -1134,6 +1250,40 @@ int64_t NetShards::codec_json_frames() const {
     t += p->json_frames.load(std::memory_order_relaxed);
   }
   return t;
+}
+
+int64_t NetShards::mac_frames() const {
+  int64_t t = 0;
+  for (auto& p : pipelines_) {
+    t += p->mac_frames.load(std::memory_order_relaxed);
+  }
+  return t;
+}
+
+int64_t NetShards::mac_rejected() const {
+  int64_t t = 0;
+  for (auto& p : pipelines_) {
+    t += p->mac_rejected.load(std::memory_order_relaxed);
+  }
+  return t;
+}
+
+void NetShards::set_mac_key(int64_t dest, const uint8_t key[32]) {
+  std::array<uint8_t, 32> k;
+  std::memcpy(k.data(), key, 32);
+  std::lock_guard<std::mutex> lk(mac_mu_);
+  mac_send_keys_[dest] = k;
+}
+
+void NetShards::erase_mac_key(int64_t dest) {
+  std::lock_guard<std::mutex> lk(mac_mu_);
+  mac_send_keys_.erase(dest);
+}
+
+std::map<int64_t, std::array<uint8_t, 32>> NetShards::mac_key_snapshot()
+    const {
+  std::lock_guard<std::mutex> lk(mac_mu_);
+  return mac_send_keys_;
 }
 
 int64_t NetShards::backpressure_events() const {

@@ -79,15 +79,22 @@ class ShardEncoded {
       : m_(std::move(m)), tally_(encode_tally) {}
   const std::string& json_payload();
   const std::string* binary_payload();  // nullptr: no binary form
+  // MAC-vector variant (ISSUE 14): lanes over the owner's shared key
+  // table (one lane per mac-negotiated peer link, whichever shard owns
+  // it), computed at most once — the serialize-once invariant extended
+  // to the authenticator mode across shards. nullptr: no MAC form.
+  const std::string* mac_payload(NetShards* owner);
 
  private:
   Message m_;
   std::atomic<int64_t>* tally_;
   std::mutex mu_;
-  std::string json_, binary_;
+  std::string json_, binary_, mac_;
   bool json_done_ = false;
   bool bin_tried_ = false;
   bool bin_ok_ = false;
+  bool mac_tried_ = false;
+  bool mac_ok_ = false;
 };
 
 // Bounded cross-thread command queue: mutex + deque, drained by swap so
@@ -146,6 +153,7 @@ struct CryptoCmd {
   std::unique_ptr<SecureChannel> chan;        // kConnEstablished (may be null)
   std::shared_ptr<std::atomic<int64_t>> out_gauge;  // conn outbound bytes
   bool codec_binary = false;
+  bool mac = false;  // link negotiated the MAC authenticator (ISSUE 14)
   bool gateway = false;
 };
 
@@ -173,6 +181,10 @@ struct KInbound {
   uint64_t conn_id = 0;       // gateway-link token for routing replies back
   bool from_gateway = false;  // request arrived over a gateway link
   bool has_signable = false;
+  // The pipeline verified this frame's MAC lane against its link's
+  // session key (ISSUE 14): the consensus thread dispatches it without
+  // the verify queue.
+  bool pre_authenticated = false;
   uint8_t signable[32] = {0};
   std::optional<Message> msg;
 };
@@ -190,6 +202,8 @@ class CryptoPipeline {
   std::atomic<int64_t> queue_depth{0};  // pbft_crypto_offload_queue_depth
   std::atomic<int64_t> bin_frames{0};
   std::atomic<int64_t> json_frames{0};
+  std::atomic<int64_t> mac_frames{0};    // MAC-vector frames sent
+  std::atomic<int64_t> mac_rejected{0};  // inbound lane mismatches
   std::atomic<int64_t> chaos_dropped{0};
   std::atomic<int64_t> drops{0};  // bounded-queue / admission drops
 
@@ -204,7 +218,8 @@ class CryptoPipeline {
   friend class NetShards;
   void handle(CryptoCmd& c);
   void open_and_forward(uint64_t conn_id, int64_t dest, std::string payload);
-  void parse_to_k(uint64_t conn_id, bool from_gateway, std::string payload);
+  void parse_to_k(uint64_t conn_id, bool from_gateway, std::string payload,
+                  SecureChannel* chan = nullptr);
   void seal_and_ship(int64_t dest, const std::string& payload);
   bool chaos_pass(int64_t dest, const std::string& framed);
   void pump_chaos(std::chrono::steady_clock::time_point now);
@@ -212,12 +227,14 @@ class CryptoPipeline {
   struct PeerState {
     bool ready = false;  // link prologue done (chan set or plaintext)
     bool codec_binary = false;
+    bool mac = false;  // link negotiated the MAC authenticator
     std::unique_ptr<SecureChannel> chan;  // null on plaintext links
     std::vector<std::string> pending;     // payloads queued pre-handshake
     std::shared_ptr<std::atomic<int64_t>> out_gauge;
   };
   struct ConnState {
     std::unique_ptr<SecureChannel> chan;  // null on plaintext links
+    bool mac = false;
     bool gateway = false;
     std::shared_ptr<std::atomic<int64_t>> out_gauge;
   };
@@ -332,6 +349,8 @@ class NetShards {
   int64_t crypto_queue_depth() const;
   int64_t codec_binary_frames() const;
   int64_t codec_json_frames() const;
+  int64_t mac_frames() const;
+  int64_t mac_rejected() const;
   int64_t backpressure_events() const;
   int64_t chaos_dropped() const;
   int64_t inbox_dropped() const {
@@ -349,11 +368,22 @@ class NetShards {
   const uint8_t* seed() const { return seed_; }
   CryptoPipeline& pipeline(int i) { return *pipelines_[i]; }
   NetShard& shard(int i) { return *shards_[i]; }
+  // Fast-path key table (ISSUE 14): sender-side lane keys per
+  // mac-negotiated dialed link, registered by the owning SHARD thread at
+  // prologue completion and read (snapshot) by whichever pipeline builds
+  // a broadcast's shared MAC vector — the only cross-shard MAC state.
+  bool fastpath_mac() const { return fastpath_mac_; }
+  void set_mac_key(int64_t dest, const uint8_t key[32]);
+  void erase_mac_key(int64_t dest);
+  std::map<int64_t, std::array<uint8_t, 32>> mac_key_snapshot() const;
 
   std::atomic<int64_t> encodes_total{0};
 
  private:
   ClusterConfig cfg_;
+  bool fastpath_mac_ = false;
+  mutable std::mutex mac_mu_;
+  std::map<int64_t, std::array<uint8_t, 32>> mac_send_keys_;
   int64_t id_;
   uint8_t seed_[32];
   std::atomic<bool>* stopping_;

@@ -63,6 +63,9 @@ int main(int argc, char** argv) {
   // Multi-core replica core (ISSUE 13): event-loop shard threads (each
   // with a companion crypto pipeline). -1 = keep network.json's value.
   int64_t net_threads = -1;
+  // Fast-path overrides (ISSUE 14): "" keeps network.json's values.
+  std::string fastpath;
+  bool tentative = false;
   // Fault injection (ISSUE 5): --fault generalizes --byzantine to the
   // full behavior-mode set; --chaos-* are seeded link-level knobs.
   std::string fault_mode_name;
@@ -84,6 +87,8 @@ int main(int argc, char** argv) {
     else if (a == "--batch-max-items") batch_max_items = std::atoll(next());
     else if (a == "--batch-flush-us") batch_flush_us = std::atoll(next());
     else if (a == "--net-threads") net_threads = std::atoll(next());
+    else if (a == "--fastpath") fastpath = next();
+    else if (a == "--tentative") tentative = true;
     else if (a == "--discovery") discovery = next();
     else if (a == "--trace") trace_path = next();
     else if (a == "--flight-file") flight_path = next();
@@ -134,6 +139,11 @@ int main(int argc, char** argv) {
   if (batch_max_items >= 1) cfg->batch_max_items = batch_max_items;
   if (batch_flush_us >= 0) cfg->batch_flush_us = batch_flush_us;
   if (net_threads >= 1) cfg->net_threads = net_threads;
+  // --fastpath mac offers the per-link MAC authenticator mode in hellos;
+  // --tentative executes + replies at PREPARED with rollback on view
+  // change (ISSUE 14). network.json stays the default source of truth.
+  if (fastpath == "sig" || fastpath == "mac") cfg->fastpath = fastpath;
+  if (tentative) cfg->tentative = true;
   uint8_t seed[32];
   if (!pbft::from_hex(seed_hex, seed, 32)) {
     std::fprintf(stderr, "bad --seed hex\n");

@@ -594,6 +594,143 @@ void stress_sharded_loops(int scale) {
   ::close(reply_fd);
 }
 
+// --- 6c. session-MAC seal/verify across shards (ISSUE 14) ------------------
+//
+// The fast-path concurrent surface: a PLAINTEXT 4-replica real-socket
+// cluster at net_threads=2 in authenticator + tentative mode — the
+// auth-only signed handshake runs on the loop shards, the established
+// channels move to the crypto pipelines which build shared MAC-vector
+// frames (lanes over the cross-shard key table) and verify inbound
+// lanes, under connect/disconnect churn and a cross-thread stop().
+// Lane keys register/erase on the shard threads while pipelines snapshot
+// the table for broadcasts: TSan-clean here is the ISSUE 14 acceptance
+// gate for the sharded MAC path.
+void stress_mac_shards(int scale) {
+  int ports[4];
+  int hold[4];
+  for (int i = 0; i < 4; ++i) {
+    hold[i] = listen_on_ephemeral(&ports[i]);
+    CHECK(hold[i] >= 0);
+  }
+  pbft::ClusterConfig cfg;
+  cfg.net_threads = 2;
+  cfg.secure = false;
+  cfg.fastpath = "mac";
+  cfg.tentative = true;
+  std::vector<std::vector<uint8_t>> seeds;
+  for (int i = 0; i < 4; ++i) {
+    std::vector<uint8_t> seed(32, (uint8_t)(i + 57));
+    pbft::ReplicaIdentity ident;
+    ident.replica_id = i;
+    ident.host = "127.0.0.1";
+    ident.port = ports[i];
+    pbft::ed25519_public_key(ident.pubkey, seed.data());
+    cfg.replicas.push_back(ident);
+    seeds.push_back(seed);
+  }
+  for (int i = 0; i < 4; ++i) ::close(hold[i]);
+  std::vector<std::unique_ptr<pbft::ReplicaServer>> servers;
+  for (int i = 0; i < 4; ++i) {
+    servers.push_back(std::make_unique<pbft::ReplicaServer>(
+        cfg, i, seeds[i].data(), std::make_unique<pbft::CpuVerifier>()));
+    servers[i]->set_chaos(/*drop_pct=*/0.01, /*delay_ms=*/3,
+                          /*seed=*/0xFA57 + (uint64_t)i);
+    servers[i]->set_view_change_timeout(400);
+    CHECK(servers[i]->start());
+  }
+  std::vector<std::thread> loops;
+  for (int i = 0; i < 4; ++i) {
+    loops.emplace_back([srv = servers[i].get()] { srv->run(); });
+  }
+
+  // Churners force link churn: every accepted/dialed mac link that dies
+  // erases its lane key from the cross-shard table while broadcasts
+  // snapshot it from the pipelines.
+  std::atomic<bool> churn_stop{false};
+  std::vector<std::thread> churners;
+  for (int t = 0; t < 3; ++t) {
+    churners.emplace_back([&, t] {
+      int i = 0;
+      while (!churn_stop.load(std::memory_order_relaxed)) {
+        const std::string addr =
+            "127.0.0.1:" + std::to_string(ports[(i + t) % 4]);
+        int fd = pbft::dial_tcp(addr);
+        ++i;
+        if (fd < 0) continue;
+        switch ((i + t) % 3) {
+          case 0:
+            break;  // instant disconnect
+          case 1: {  // partial length prefix parks bytes in a shard rbuf
+            uint8_t partial[3] = {0x00, 0x00, 0x01};
+            (void)!::send(fd, partial, sizeof(partial), MSG_NOSIGNAL);
+            break;
+          }
+          default: {  // a lonely 1.3.0 hello, then vanish mid-handshake
+            const std::string hello = pbft::frame_payload(
+                pbft::SecureChannel::plain_hello(7, true));
+            (void)!::send(fd, hello.data(), hello.size(), MSG_NOSIGNAL);
+            break;
+          }
+        }
+        ::close(fd);
+      }
+    });
+  }
+
+  int reply_port = 0;
+  int reply_fd = listen_on_ephemeral(&reply_port);
+  CHECK(reply_fd >= 0);
+  const std::string reply_addr = "127.0.0.1:" + std::to_string(reply_port);
+  const int requests = 2 * scale;
+  int replies_seen = 0;
+  for (int r = 0; r < requests; ++r) {
+    const std::string req =
+        "{\"type\":\"client-request\",\"operation\":\"mac-" +
+        std::to_string(r) + "\",\"timestamp\":" + std::to_string(r + 1) +
+        ",\"client\":\"" + reply_addr + "\"}\n";
+    bool replied = false;
+    auto deadline =
+        std::chrono::steady_clock::now() + std::chrono::seconds(20);
+    int attempt = 0;
+    while (!replied && std::chrono::steady_clock::now() < deadline) {
+      int fd = pbft::dial_tcp("127.0.0.1:" +
+                              std::to_string(ports[attempt++ % 4]));
+      if (fd >= 0) {
+        (void)!::send(fd, req.data(), req.size(), MSG_NOSIGNAL);
+        ::close(fd);
+      }
+      auto retry_at = std::chrono::steady_clock::now() +
+                      std::chrono::milliseconds(400);
+      while (std::chrono::steady_clock::now() < retry_at) {
+        pollfd pfd{reply_fd, POLLIN, 0};
+        if (::poll(&pfd, 1, 50) <= 0) continue;
+        int cfd = ::accept(reply_fd, nullptr, nullptr);
+        if (cfd < 0) continue;
+        char buf[512];
+        ssize_t n = ::recv(cfd, buf, sizeof(buf) - 1, 0);
+        ::close(cfd);
+        if (n > 0) {
+          replied = true;
+          ++replies_seen;
+          break;
+        }
+      }
+    }
+  }
+  CHECK(replies_seen == requests);
+  churn_stop.store(true, std::memory_order_relaxed);
+  for (auto& t : churners) t.join();
+  for (auto& s : servers) s->stop();
+  for (auto& t : loops) t.join();
+  bool mac_flowed = false;
+  for (auto& s : servers) {
+    if (s->replica().counters["mac_verified"] > 0) mac_flowed = true;
+    CHECK(s->replica().committed_upto() <= s->replica().executed_upto());
+  }
+  CHECK(mac_flowed);
+  ::close(reply_fd);
+}
+
 // --- 7. connect/disconnect churn vs the edge-triggered loop ----------------
 //
 // ISSUE 10: the epoll rewrite registers fds once at accept/dial and
@@ -857,6 +994,9 @@ int main(int argc, char** argv) {
   stress_chaos_cluster(scale);
   std::printf("[race_stress] sharded loops + crypto pipelines (ISSUE 13)...\n");
   stress_sharded_loops(scale);
+  std::printf("[race_stress] session-MAC seal/verify across shards "
+              "(ISSUE 14)...\n");
+  stress_mac_shards(scale);
   std::printf("[race_stress] connect/disconnect churn vs ET loop...\n");
   stress_conn_churn(scale);
   std::printf("[race_stress] gateway-failover churn...\n");

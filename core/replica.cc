@@ -35,6 +35,9 @@ std::optional<ClusterConfig> ClusterConfig::from_json_text(
   if (const Json* v = j->find("admission_backlog"))
     cfg.admission_backlog = v->as_int();
   if (const Json* v = j->find("net_threads")) cfg.net_threads = v->as_int();
+  if (const Json* v = j->find("fastpath"); v && v->is_string())
+    cfg.fastpath = v->as_string();
+  if (const Json* v = j->find("tentative")) cfg.tentative = v->as_bool();
   if (const Json* v = j->find("verifier"); v && v->is_string())
     cfg.verifier = v->as_string();
   if (const Json* v = j->find("secure")) cfg.secure = v->as_bool();
@@ -62,11 +65,13 @@ Replica::Replica(ClusterConfig config, int64_t replica_id,
   std::memcpy(seed_, seed, 32);
   static const char* kGenesis = "pbft-genesis";
   blake2b_256(state_digest_, (const uint8_t*)kGenesis, std::strlen(kGenesis));
+  std::memcpy(committed_chain_, state_digest_, 32);
   for (const char* name :
-       {"sig_verified", "sig_rejected", "pre_prepares_accepted",
-        "prepares_accepted", "commits_accepted", "executed",
-        "rounds_executed", "duplicate_requests", "checkpoints_stable",
-        "state_transfers"}) {
+       {"sig_verified", "sig_rejected", "mac_verified",
+        "tentative_executions", "tentative_rollbacks",
+        "pre_prepares_accepted", "prepares_accepted", "commits_accepted",
+        "executed", "rounds_executed", "duplicate_requests",
+        "checkpoints_stable", "state_transfers"}) {
     counters[name] = 0;
   }
 }
@@ -173,7 +178,7 @@ Actions Replica::receive(const Message& msg) {
   if (std::holds_alternative<ClientRequest>(msg)) {
     return on_client_request(std::get<ClientRequest>(msg));
   }
-  inbox_.push_back(InboxEntry{msg, false, {}});
+  inbox_.push_back(InboxEntry{msg, false, false, {}});
   return {};
 }
 
@@ -181,10 +186,31 @@ Actions Replica::receive(const Message& msg, const uint8_t signable[32]) {
   if (std::holds_alternative<ClientRequest>(msg)) {
     return on_client_request(std::get<ClientRequest>(msg));
   }
-  InboxEntry e{msg, true, {}};
+  InboxEntry e{msg, true, false, {}};
   std::memcpy(e.signable, signable, 32);
   inbox_.push_back(std::move(e));
   return {};
+}
+
+Actions Replica::receive_authenticated(const Message& msg) {
+  counters["mac_verified"] += 1;
+  if (std::holds_alternative<ClientRequest>(msg)) {
+    return on_client_request(std::get<ClientRequest>(msg));
+  }
+  // ORDERING (ISSUE 14): when the verify inbox is non-empty the message
+  // queues BEHIND it (pre-verified) instead of dispatching immediately —
+  // a MAC frame overtaking a still-unverified NEW-VIEW from the same
+  // sender would be dropped as belonging to a view this replica has not
+  // entered yet, and the primary's per-view duplicate suppression then
+  // pins the request until the NEXT view change (a liveness wedge the
+  // chaos soak caught). The inbox only ever holds the rare signed types
+  // in MAC mode, so the fast path stays fast.
+  if (!inbox_.empty()) {
+    InboxEntry e{msg, false, true, {}};
+    inbox_.push_back(std::move(e));
+    return {};
+  }
+  return dispatch(msg);
 }
 
 namespace {
@@ -216,6 +242,7 @@ std::vector<VerifyItem> Replica::pending_items() const {
   std::vector<VerifyItem> items;
   items.reserve(inbox_.size());
   for (const InboxEntry& e : inbox_) {
+    if (e.pre_authenticated) continue;  // passes without a verdict
     const Message& msg = e.msg;
     VerifyItem item{};
     int64_t rid = replica_of(msg);
@@ -239,17 +266,32 @@ std::vector<VerifyItem> Replica::pending_items() const {
 }
 
 Actions Replica::deliver_verdicts(const std::vector<uint8_t>& verdicts) {
+  // Arrival order, with pre-authenticated (MAC-accepted) entries passing
+  // for free — they queued behind the signed types purely for ordering
+  // and were counted at receive; verification-needing entries consume
+  // one verdict each, and trailing pre-authenticated entries drain
+  // greedily once the verdicts run out.
   Actions out;
-  size_t n = std::min(verdicts.size(), inbox_.size());
-  for (size_t i = 0; i < n; ++i) {
-    Message msg = std::move(inbox_.front().msg);
-    inbox_.pop_front();
-    if (!verdicts[i]) {
-      counters["sig_rejected"] += 1;
-      continue;
+  size_t vi = 0;
+  while (!inbox_.empty()) {
+    InboxEntry& front = inbox_.front();
+    bool ok;
+    if (front.pre_authenticated) {
+      ok = true;
+    } else {
+      if (vi >= verdicts.size()) break;
+      ok = verdicts[vi] != 0;
+      ++vi;
+      if (!ok) {
+        counters["sig_rejected"] += 1;
+        inbox_.pop_front();
+        continue;
+      }
+      counters["sig_verified"] += 1;
     }
-    counters["sig_verified"] += 1;
-    out.merge(dispatch(msg));
+    Message msg = std::move(front.msg);
+    inbox_.pop_front();
+    if (ok) out.merge(dispatch(msg));
   }
   return out;
 }
@@ -342,6 +384,18 @@ Actions Replica::maybe_commit(const Key& key) {
   cm = sign(cm);
   Actions out;
   out.broadcasts.push_back({Message(cm)});
+  if (config_.tentative) {
+    // Tentative execution (ISSUE 14, §5.3): PREPARED is the execute
+    // point — the reply leaves one commit round-trip early, flagged
+    // tentative; the commit quorum later promotes it (and a view change
+    // before that rolls it back).
+    if (key.second > executed_upto_ &&
+        !pending_execution_.count(key.second)) {
+      pending_execution_[key.second] = {key.first,
+                                        pre_prepares_.at(key).digest};
+      out.merge(drain_executions());
+    }
+  }
   out.merge(insert_commit(cm));
   return out;
 }
@@ -375,6 +429,14 @@ bool Replica::committed_local(const Key& key) const {
 Actions Replica::maybe_execute(const Key& key) {
   if (!committed_local(key)) return {};
   int64_t seq = key.second;
+  if (config_.tentative && seq <= executed_upto_) {
+    // Already executed (tentatively) — the commit quorum arrived now:
+    // advance the committed floor. No "committed" phase stamp: the span
+    // closed at the tentative execution, and a committed stamp after
+    // "executed" would violate the phase-order invariant.
+    if (seq <= committed_upto_ || committed_seqs_.count(seq)) return {};
+    return note_committed(seq);
+  }
   if (seq <= executed_upto_ || pending_execution_.count(seq)) return {};
   pending_execution_[seq] = {key.first, pre_prepares_.at(key).digest};
   if (phase_hook) phase_hook("committed", key.first, seq);
@@ -387,10 +449,31 @@ Actions Replica::drain_executions() {
     int64_t seq = executed_upto_ + 1;
     auto [view, digest] = pending_execution_[seq];
     pending_execution_.erase(seq);
+    // Tentative mode: is this execution already backed by a commit
+    // quorum (definitive) or only by the prepared certificate
+    // (tentative — reply flagged, undo recorded)?
+    const bool tentative_mode = config_.tentative;
+    const bool committed_now =
+        !tentative_mode || committed_local({view, seq});
+    Undo* undo = nullptr;
+    if (tentative_mode) {
+      // Undo record for EVERY executed sequence above the committed
+      // floor (committed-now ones included — rollback walks the whole
+      // suffix): prior chain digest, per-request prior exactly-once
+      // entries, app snapshot when stateful.
+      Undo u;
+      std::memcpy(u.chain, state_digest_, 32);
+      if (app_snapshot) {
+        u.have_app = true;
+        u.app_snapshot = app_snapshot();
+      }
+      undo = &tentative_undo_.emplace(seq, std::move(u)).first->second;
+    }
     auto ppit = pre_prepares_.find({view, seq});
     if (ppit == pre_prepares_.end()) {
       executed_upto_ = seq;  // truncated past us; needs state transfer
       if (phase_hook) phase_hook("executed", view, seq);
+      if (tentative_mode && committed_now) out.merge(note_committed(seq));
       continue;
     }
     const std::vector<ClientRequest>& batch = ppit->second.requests;
@@ -420,6 +503,20 @@ Actions Replica::drain_executions() {
         counters["duplicate_requests"] += 1;
         continue;
       }
+      if (undo != nullptr) {
+        UndoItem item;
+        item.client = req.client;
+        if (it != last_timestamp_.end()) {
+          item.had_ts = true;
+          item.prev_ts = it->second;
+        }
+        auto rit = last_reply_.find(req.client);
+        if (rit != last_reply_.end()) {
+          item.had_reply = true;
+          item.prev_reply = rit->second;
+        }
+        undo->items.push_back(std::move(item));
+      }
       // Execution: the reference's app is a no-op returning "awesome!"
       // (reference src/message.rs:70); kept as the built-in default —
       // a stateful app overrides via the app_execute hook.
@@ -441,17 +538,73 @@ Actions Replica::drain_executions() {
       reply.client = req.client;
       reply.replica = id_;
       reply.result = result;
+      reply.tentative = committed_now ? 0 : 1;
       reply = sign(reply);  // §4.1: a reply vote must prove its caster
       last_reply_[req.client] = reply;
       out.replies.push_back({req.client, reply});
     }
     if (seq % config_.checkpoint_interval == 0) {
       std::string payload = checkpoint_payload(seq);
-      snapshots_[seq] = payload;
+      if (tentative_mode) {
+        // Deferred emission: the payload is captured NOW (the state IS
+        // the state at seq) but the Checkpoint message waits for the
+        // commit point — a checkpoint may only ever cover state that
+        // cannot roll back.
+        pending_checkpoints_[seq] = std::move(payload);
+      } else {
+        snapshots_[seq] = payload;
+        uint8_t d[32];
+        blake2b_256(d, (const uint8_t*)payload.data(), payload.size());
+        Checkpoint cp;
+        cp.seq = seq;
+        cp.digest = to_hex(d, 32);
+        cp.replica = id_;
+        cp = sign(cp);
+        out.broadcasts.push_back({Message(cp)});
+        out.merge(insert_checkpoint(cp));
+      }
+    }
+    if (tentative_mode) {
+      if (committed_now) {
+        out.merge(note_committed(seq));
+      } else {
+        counters["tentative_executions"] += 1;
+      }
+    }
+  }
+  if (!config_.tentative) {
+    // Signature mode: every execution is definitive — the floor tracks
+    // execution so the progress/metrics surface is uniform.
+    committed_upto_ = executed_upto_;
+    std::memcpy(committed_chain_, state_digest_, 32);
+  }
+  return out;
+}
+
+// -- tentative promotion & rollback (ISSUE 14, §5.3) -------------------------
+
+Actions Replica::note_committed(int64_t seq) {
+  // Sequence `seq` is committed-local AND executed: advance the
+  // committed floor over every contiguously-committed sequence, retire
+  // their undo records, refresh committed_chain, and emit any
+  // checkpoint whose (deferred) interval boundary the floor crossed.
+  Actions out;
+  if (seq <= committed_upto_) return out;
+  committed_seqs_.insert(seq);
+  while (committed_seqs_.count(committed_upto_ + 1)) {
+    committed_upto_ += 1;
+    const int64_t s = committed_upto_;
+    committed_seqs_.erase(s);
+    tentative_undo_.erase(s);
+    auto pit = pending_checkpoints_.find(s);
+    if (pit != pending_checkpoints_.end()) {
+      std::string payload = std::move(pit->second);
+      pending_checkpoints_.erase(pit);
+      snapshots_[s] = payload;
       uint8_t d[32];
       blake2b_256(d, (const uint8_t*)payload.data(), payload.size());
       Checkpoint cp;
-      cp.seq = seq;
+      cp.seq = s;
       cp.digest = to_hex(d, 32);
       cp.replica = id_;
       cp = sign(cp);
@@ -459,7 +612,56 @@ Actions Replica::drain_executions() {
       out.merge(insert_checkpoint(cp));
     }
   }
+  auto nxt = tentative_undo_.find(committed_upto_ + 1);
+  if (nxt != tentative_undo_.end()) {
+    std::memcpy(committed_chain_, nxt->second.chain, 32);
+  } else {
+    std::memcpy(committed_chain_, state_digest_, 32);
+  }
   return out;
+}
+
+void Replica::rollback_tentative() {
+  // Undo every execution above the committed floor, newest first
+  // (view-change entry, or a certified checkpoint past the floor):
+  // chain digest, per-client exactly-once timestamps, cached replies,
+  // and app state all revert to the committed point. Clients that
+  // accepted a reply are safe regardless: 2f+1 matching tentative votes
+  // imply f+1 honest replicas holding the full prepared certificate,
+  // and any new-view quorum intersects them — the same batch is
+  // re-issued at the same sequence.
+  if (!config_.tentative || executed_upto_ <= committed_upto_) return;
+  int64_t rolled = 0;
+  for (int64_t seq = executed_upto_; seq > committed_upto_; --seq) {
+    pending_checkpoints_.erase(seq);
+    committed_seqs_.erase(seq);
+    auto uit = tentative_undo_.find(seq);
+    if (uit == tentative_undo_.end()) continue;  // defensive
+    Undo& undo = uit->second;
+    std::memcpy(state_digest_, undo.chain, 32);
+    for (auto it = undo.items.rbegin(); it != undo.items.rend(); ++it) {
+      if (it->had_ts) {
+        last_timestamp_[it->client] = it->prev_ts;
+      } else {
+        last_timestamp_.erase(it->client);
+      }
+      if (it->had_reply) {
+        last_reply_[it->client] = it->prev_reply;
+      } else {
+        last_reply_.erase(it->client);
+      }
+    }
+    if (undo.have_app && app_restore) app_restore(undo.app_snapshot);
+    tentative_undo_.erase(uit);
+    rolled += 1;
+  }
+  executed_upto_ = committed_upto_;
+  std::memcpy(committed_chain_, state_digest_, 32);
+  for (auto it = pending_execution_.begin(); it != pending_execution_.end();) {
+    it = it->first > committed_upto_ ? pending_execution_.erase(it)
+                                    : std::next(it);
+  }
+  if (rolled) counters["tentative_rollbacks"] += rolled;
 }
 
 std::string Replica::checkpoint_payload(int64_t seq) const {
@@ -477,6 +679,9 @@ std::string Replica::checkpoint_payload(int64_t seq) const {
     Json rj = reply.to_json();
     rj.as_object()["replica"] = Json((int64_t)-1);
     rj.as_object()["sig"] = Json(std::string());  // replica-local too
+    // Normalized away (mirrors replica.py): by emission time the prefix
+    // is committed, and capture-time flag skew must not fork the bytes.
+    rj.as_object().erase(kTentativeField);
     replies.push_back(Json(JsonArray{Json(client), std::move(rj)}));
   }
   o.emplace("replies", Json(std::move(replies)));
@@ -546,6 +751,13 @@ Actions Replica::on_state_response(const StateResponse& resp) {
   last_reply_ = std::move(new_replies);
   last_timestamp_ = std::move(new_timestamps);
   executed_upto_ = resp.seq;
+  // The fetched state is 2f+1-certified: the committed floor moves with
+  // it and any stale tentative bookkeeping dies here.
+  committed_upto_ = resp.seq;
+  std::memcpy(committed_chain_, chain_bytes, 32);
+  tentative_undo_.clear();
+  committed_seqs_.clear();
+  pending_checkpoints_.clear();
   snapshots_[resp.seq] = resp.snapshot;  // we can serve peers now
   awaiting_state_.reset();
   counters["state_transfers"] += 1;
@@ -569,6 +781,15 @@ Actions Replica::on_checkpoint(const Checkpoint& cp) {
 }
 
 Actions Replica::insert_checkpoint(const Checkpoint& cp) {
+  // MAC mode (ISSUE 14): checkpoints were accepted by their link lane,
+  // but their embedded signatures are what stable-checkpoint
+  // CERTIFICATES are made of — admit only provable evidence, or one
+  // sig-corrupting peer poisons every honest VIEW-CHANGE. Rare (one per
+  // interval per replica): the inline verify is off the hot path.
+  if (config_.fastpath == "mac" &&
+      !verify_inline(cp.replica, Message(cp), cp.sig)) {
+    return {};
+  }
   auto& slot = checkpoints_[cp.seq];
   if (slot.count(cp.replica)) return {};
   slot.emplace(cp.replica, cp);
@@ -594,6 +815,13 @@ Actions Replica::insert_checkpoint(const Checkpoint& cp) {
 Actions Replica::advance_watermark(int64_t stable_seq,
                                    const std::string& stable_digest) {
   if (stable_seq <= low_mark_) return {};
+  if (config_.tentative && stable_seq > committed_upto_) {
+    // A 2f+1 quorum checkpointed past our committed floor: the
+    // tentative suffix we hold may not match the certified chain —
+    // revert to the committed point and catch up through the certified
+    // state (the state-transfer branch below).
+    rollback_tentative();
+  }
   low_mark_ = stable_seq;
   counters["checkpoints_stable"] += 1;
   Actions out;
@@ -699,18 +927,32 @@ Actions Replica::retransmit_view_change() {
 JsonArray Replica::prepared_proofs() const {
   // P: per sequence prepared above the low watermark, the pre-prepare +
   // its 2f matching backup prepares (highest view wins per sequence).
+  //
+  // Only evidence with VALID signatures ships (ISSUE 14): in MAC mode
+  // the hot path accepts frames by their lane without checking the
+  // embedded signature, so a sig-corrupting Byzantine peer can place
+  // garbage-signature prepares in honest logs — shipping one would make
+  // validators reject this replica's whole VIEW-CHANGE. A slot that
+  // cannot assemble a fully-valid certificate is not claimed (client
+  // retransmission re-orders it in the new view). In signature mode
+  // every logged message was already verified: the filter is a no-op.
   std::map<int64_t, std::pair<int64_t, Json>> best;  // seq -> (view, entry)
   for (const auto& [key, pp] : pre_prepares_) {
     auto [view, seq] = key;
     if (seq <= low_mark_ || !prepared(key)) continue;
     int64_t prim = config_.primary_of(view);
+    if (!verify_inline(prim, Message(pp), pp.sig)) continue;
     JsonArray preps;
     auto slot = prepares_.find(key);
     if (slot != prepares_.end()) {
       for (const auto& [rid, p] : slot->second) {
-        if (rid != prim && p.digest == pp.digest) preps.push_back(p.to_json());
+        if (rid != prim && p.digest == pp.digest &&
+            verify_inline(p.replica, Message(p), p.sig)) {
+          preps.push_back(p.to_json());
+        }
       }
     }
+    if ((int64_t)preps.size() < 2 * config_.f()) continue;
     JsonObject entry;
     entry.emplace("pre_prepare", pp.to_json());
     entry.emplace("prepares", Json(std::move(preps)));
@@ -993,6 +1235,10 @@ Actions Replica::on_new_view(const NewView& nv) {
 Actions Replica::enter_new_view(int64_t v, int64_t min_s,
                                 const ViewChange* stable_vc,
                                 const std::vector<PrePrepare>& pps) {
+  // Tentative executions do not survive a view change (§5.3): roll the
+  // uncommitted suffix back BEFORE processing the new view's O — its
+  // re-issued pre-prepares re-run the three-phase protocol.
+  rollback_tentative();
   view_ = v;
   in_view_change_ = false;
   pending_view_ = 0;

@@ -78,6 +78,14 @@ struct ClusterConfig {
   // runtime accepts the key and stays single-loop (it logs as much);
   // the default is constants-linted against consensus/config.py.
   int64_t net_threads = 1;
+  // Fast-path modes (ISSUE 14, protocol 1.3.0; defaults constants-linted
+  // against consensus/config.py). fastpath = "mac" offers the per-link
+  // MAC-vector authenticator mode in hellos (normal-case frames on
+  // mutually-offering links skip hot-path signature verification);
+  // tentative = true executes + replies at PREPARED with rollback on
+  // view change (clients accept 2f+1 matching tentative votes).
+  std::string fastpath = "sig";
+  bool tentative = false;
   std::string verifier = "cpu";  // "cpu" | "host:port" | "/unix/path"
   // Encrypted replica-replica links (core/secure.cc; the reference's
   // development_transport bundles Noise on every link, src/main.rs:42).
@@ -139,6 +147,11 @@ class Replica {
   // delivery, tests) computes it there instead.
   Actions receive(const Message& msg);
   Actions receive(const Message& msg, const uint8_t signable[32]);
+  // Dispatch a message the net layer already authenticated via its
+  // per-link session MAC (ISSUE 14 authenticator mode): no verify
+  // queue, no signature check — the caller proved the sender and
+  // checked the claimed replica id against the link's peer.
+  Actions receive_authenticated(const Message& msg);
   std::vector<VerifyItem> pending_items() const;
   // Queue depth without building the items — the event loop's bounded
   // accumulation (verify_flush_us) checks this every pass.
@@ -164,6 +177,19 @@ class Replica {
   }
   int64_t seal_backlog() const {
     return seq_counter_ > executed_upto_ ? seq_counter_ - executed_upto_ : 0;
+  }
+  // Tentative execution surface (ISSUE 14, §5.3): the committed floor
+  // (everything at or below it is committed-local AND executed; the
+  // suffix above ran tentatively and can roll back), the chain digest
+  // AT that floor, and what the view timer should treat as progress
+  // (committed sequences in tentative mode — tentative executions roll
+  // back and must not placate the timer while commits starve).
+  int64_t committed_upto() const { return committed_upto_; }
+  std::string committed_chain_hex() const {
+    return to_hex(committed_chain_, 32);
+  }
+  int64_t progress_marker() const {
+    return config_.tentative ? committed_upto_ : executed_upto_;
   }
   // True when accepted pre-prepares (or committed-but-unexecuted slots)
   // sit above executed_upto — the net layer's request-timer signal.
@@ -270,6 +296,31 @@ class Replica {
   int64_t low_mark_ = 0;
   int64_t executed_upto_ = 0;
   uint8_t state_digest_[32];
+  // Tentative execution (ISSUE 14; mirrors consensus/replica.py): the
+  // committed floor, the chain digest at it, per-sequence undo records
+  // for the tentative suffix, sequences committed-local-and-executed
+  // but not yet contiguous with the floor, and checkpoint payloads
+  // captured at execution whose emission waits for the commit point.
+  struct UndoItem {
+    std::string client;
+    bool had_ts = false;
+    int64_t prev_ts = 0;
+    bool had_reply = false;
+    ClientReply prev_reply;
+  };
+  struct Undo {
+    uint8_t chain[32] = {0};
+    std::vector<UndoItem> items;
+    bool have_app = false;
+    std::string app_snapshot;
+  };
+  int64_t committed_upto_ = 0;
+  uint8_t committed_chain_[32];
+  std::map<int64_t, Undo> tentative_undo_;
+  std::set<int64_t> committed_seqs_;
+  std::map<int64_t, std::string> pending_checkpoints_;
+  Actions note_committed(int64_t seq);
+  void rollback_tentative();
 
   std::map<Key, PrePrepare> pre_prepares_;
   std::map<Key, std::map<int64_t, Prepare>> prepares_;
@@ -294,6 +345,9 @@ class Replica {
   struct InboxEntry {
     Message msg;
     bool has_signable = false;
+    // MAC-accepted frame queued behind unverified signed types purely
+    // for ordering (ISSUE 14): passes without consuming a verdict.
+    bool pre_authenticated = false;
     uint8_t signable[32];
   };
   std::deque<InboxEntry> inbox_;

@@ -20,13 +20,29 @@ bool wire_json_forced() {
   }();
   return forced;
 }
+
+// PBFT_PROTO_CAP=1.2.0 advertises the 1.2.0 hello with no fast-path
+// offer — the interop-test lever simulating a pre-1.3.0 peer.
+bool proto_capped_12() {
+  static const bool capped = [] {
+    const char* v = std::getenv("PBFT_PROTO_CAP");
+    return v != nullptr && std::strcmp(v, "1.2.0") == 0;
+  }();
+  return capped;
+}
 }  // namespace
 
 const char* wire_hello_version() {
-  return wire_json_forced() ? kProtocolVersionLegacy : kProtocolVersion;
+  if (wire_json_forced()) return kProtocolVersionLegacy;
+  if (proto_capped_12()) return kProtocolVersionBatch;
+  return kProtocolVersion;
 }
 
 bool wire_offer_binary() { return !wire_json_forced(); }
+
+bool wire_offer_mac(bool fastpath_mac) {
+  return fastpath_mac && !wire_json_forced() && !proto_capped_12();
+}
 
 bool hello_offers_binary(const Json& obj) {
   if (!wire_offer_binary()) return false;
@@ -36,6 +52,29 @@ bool hello_offers_binary(const Json& obj) {
     if (c.is_string() && c.as_string() == kCodecBinary2) return true;
   }
   return false;
+}
+
+bool hello_offers_mac(const Json& obj) {
+  const Json* auth = obj.find("auth");
+  if (!auth || !auth->is_array()) return false;
+  for (const Json& a : auth->as_array()) {
+    if (a.is_string() && a.as_string() == kAuthModeMac) return true;
+  }
+  return false;
+}
+
+void mac_tag(const uint8_t key[32], const uint8_t signable[32],
+             uint8_t out[kMacTagLen]) {
+  std::string data = kMacContext;
+  data.append((const char*)signable, 32);
+  blake2b_keyed(out, kMacTagLen, key, 32, (const uint8_t*)data.data(),
+                data.size());
+}
+
+bool mac_tag_equal(const uint8_t a[kMacTagLen], const uint8_t b[kMacTagLen]) {
+  uint8_t acc = 0;
+  for (size_t i = 0; i < kMacTagLen; ++i) acc |= a[i] ^ b[i];
+  return acc == 0;
 }
 
 namespace {
@@ -98,6 +137,20 @@ void derive_key(uint8_t out[64], const uint8_t shared[32], const char* label,
   blake2b_keyed(out, 64, shared, 32, (const uint8_t*)data.data(), data.size());
 }
 
+// 32-byte authenticator key: the same KDF shape at digest size 32
+// (net/secure.py derive_auth_keys).
+void derive_auth_key32(uint8_t out[32], const uint8_t shared[32],
+                       const char* label, const uint8_t eph_i[32],
+                       const uint8_t eph_r[32]) {
+  std::string data = kKdfContext;
+  data += label;
+  data += '|';
+  data.append((const char*)eph_i, 32);
+  data += '|';
+  data.append((const char*)eph_r, 32);
+  blake2b_keyed(out, 32, shared, 32, (const uint8_t*)data.data(), data.size());
+}
+
 bool ct_equal(const uint8_t* a, const uint8_t* b, size_t n) {
   uint8_t acc = 0;
   for (size_t i = 0; i < n; ++i) acc |= a[i] ^ b[i];
@@ -156,11 +209,14 @@ std::optional<std::string> aead_open(const uint8_t key[64], uint64_t ctr,
 
 SecureChannel::SecureChannel(const ClusterConfig* cfg, int64_t my_id,
                              const uint8_t identity_seed[32], bool initiator,
-                             int64_t expected_peer)
+                             int64_t expected_peer, bool offer_mac,
+                             bool auth_only)
     : cfg_(cfg),
       my_id_(my_id),
       initiator_(initiator),
       expected_peer_(expected_peer),
+      offer_mac_(offer_mac),
+      auth_only_(auth_only),
       hs_version_(wire_hello_version()) {
   std::memcpy(seed_, identity_seed, 32);
   fill_random(eph_secret_, 32);
@@ -171,11 +227,12 @@ bool SecureChannel::check_version(const Json& obj, std::string* err) {
   const Json* v = obj.find("ver");
   std::string ver = v && v->is_string() ? v->as_string() : "<none>";
   // Compatible set, not exact match: 1.1.0 only ADDS the negotiated
-  // binary codec and 1.2.0 the batched pre-prepare (batch=1 frames are
-  // byte-identical), so older peers interoperate (JSON both ways for
-  // 1.0.0; bin2 batch=1 for 1.1.0).
-  if (ver != kProtocolVersion && ver != kProtocolVersionBin2 &&
-      ver != kProtocolVersionLegacy) {
+  // binary codec, 1.2.0 the batched pre-prepare (batch=1 frames are
+  // byte-identical), and 1.3.0 the offer-gated fast-path modes, so
+  // older peers interoperate (JSON both ways for 1.0.0; bin2 batch=1
+  // for 1.1.0; signature mode for pre-1.3.0).
+  if (ver != kProtocolVersion && ver != kProtocolVersionBatch &&
+      ver != kProtocolVersionBin2 && ver != kProtocolVersionLegacy) {
     *err = "protocol version mismatch: peer speaks '" + ver +
            "', this node speaks '" + kProtocolVersion + "'";
     return false;
@@ -243,6 +300,14 @@ bool SecureChannel::finish() {
   derive_key(k_r2i, shared, "r2i", eph_i, eph_r);
   std::memcpy(send_key_, initiator_ ? k_i2r : k_r2i, 64);
   std::memcpy(recv_key_, initiator_ ? k_r2i : k_i2r, 64);
+  // Authenticator session keys (ISSUE 14): same transcript material,
+  // distinct labels — lanes and frame sealing never share key bytes.
+  // Byte-identical to net/secure.py derive_auth_keys.
+  uint8_t a_i2r[32], a_r2i[32];
+  derive_auth_key32(a_i2r, shared, "a-i2r", eph_i, eph_r);
+  derive_auth_key32(a_r2i, shared, "a-r2i", eph_i, eph_r);
+  std::memcpy(auth_send_key_, initiator_ ? a_i2r : a_r2i, 32);
+  std::memcpy(auth_recv_key_, initiator_ ? a_r2i : a_i2r, 32);
   established_ = true;
   return true;
 }
@@ -251,12 +316,19 @@ namespace {
 // Codec offer attached to every hello this node emits (unless JSON is
 // forced): the receiver may then send binary-v2 hot-message frames back
 // on its own dialed link, and the dialing side reads the responder's
-// offer to pick this link's codec.
-void attach_codecs(JsonObject* o) {
-  if (!wire_offer_binary()) return;
-  JsonArray codecs;
-  codecs.push_back(Json(kCodecBinary2));
-  (*o)["codecs"] = Json(std::move(codecs));
+// offer to pick this link's codec. The fast-path auth offer (ISSUE 14)
+// rides the same hello under the "auth" key.
+void attach_codecs(JsonObject* o, bool offer_mac = false) {
+  if (wire_offer_binary()) {
+    JsonArray codecs;
+    codecs.push_back(Json(kCodecBinary2));
+    (*o)["codecs"] = Json(std::move(codecs));
+  }
+  if (wire_offer_mac(offer_mac)) {
+    JsonArray auth;
+    auth.push_back(Json(kAuthModeMac));
+    (*o)["auth"] = Json(std::move(auth));
+  }
 }
 }  // namespace
 
@@ -266,7 +338,7 @@ std::string SecureChannel::initiator_hello() {
   o["ver"] = Json(wire_hello_version());
   o["node"] = Json(my_id_);
   o["eph"] = Json(to_hex(eph_pub_, 32));
-  attach_codecs(&o);
+  attach_codecs(&o, offer_mac_);
   return Json(o).dump();
 }
 
@@ -284,6 +356,7 @@ std::optional<std::string> SecureChannel::on_hello(const Json& obj) {
   // version (check_version admitted it into the compatible set).
   const Json* ver = obj.find("ver");
   if (ver && ver->is_string()) hs_version_ = ver->as_string();
+  peer_offers_mac_ = pbft::hello_offers_mac(obj);
   have_peer_eph_ = true;
   uint8_t th[32];
   transcript(th);
@@ -297,7 +370,7 @@ std::optional<std::string> SecureChannel::on_hello(const Json& obj) {
   o["node"] = Json(my_id_);
   o["eph"] = Json(to_hex(eph_pub_, 32));
   o["sig"] = Json(to_hex(sig, 64));
-  attach_codecs(&o);
+  attach_codecs(&o, offer_mac_);
   return Json(o).dump();
 }
 
@@ -316,6 +389,7 @@ std::optional<std::string> SecureChannel::on_hello_reply(const Json& obj) {
     error_ = "responder hello carried no ephemeral key";
     return std::nullopt;
   }
+  peer_offers_mac_ = pbft::hello_offers_mac(obj);
   have_peer_eph_ = true;
   if (!verify_peer_sig(obj, "|resp")) return std::nullopt;
   uint8_t th[32];
@@ -365,12 +439,12 @@ std::string SecureChannel::reject_payload(const std::string& reason) {
   return Json(o).dump();
 }
 
-std::string SecureChannel::plain_hello(int64_t my_id) {
+std::string SecureChannel::plain_hello(int64_t my_id, bool offer_mac) {
   JsonObject o;
   o["type"] = Json("hello");
   o["ver"] = Json(wire_hello_version());
   o["node"] = Json(my_id);
-  attach_codecs(&o);
+  attach_codecs(&o, offer_mac);
   return Json(o).dump();
 }
 

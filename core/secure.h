@@ -25,20 +25,50 @@ namespace pbft {
 // 1.2.0 adds the batched pre-prepare (binary 0x06 / JSON `requests`,
 // ISSUE 4); batch=1 frames stay byte-identical to 1.1.0, so 1.1.0 and
 // 1.0.0 peers remain in the compatible set — a batching primary simply
-// must not be pointed at them with batch_max_items > 1.
-inline constexpr const char* kProtocolVersion = "pbft-tpu/1.2.0";
+// must not be pointed at them with batch_max_items > 1. 1.3.0 adds the
+// fast-path modes (ISSUE 14): per-link session-MAC authenticators on
+// normal-case frames (the MAC-vector binary variants, core/messages.h
+// 0x12-0x16) and the tentative client-reply flag; a link runs MAC mode
+// only when BOTH hellos offered kAuthModeMac, so every older peer falls
+// back to signature mode byte-for-byte.
+inline constexpr const char* kProtocolVersion = "pbft-tpu/1.3.0";
+inline constexpr const char* kProtocolVersionBatch = "pbft-tpu/1.2.0";
 inline constexpr const char* kProtocolVersionBin2 = "pbft-tpu/1.1.0";
 inline constexpr const char* kProtocolVersionLegacy = "pbft-tpu/1.0.0";
 inline constexpr size_t kTagLen = 16;
 
-// The hello this node sends: kProtocolVersion with codecs ["bin2"], or
-// the legacy 1.0.0 JSON-only hello when PBFT_WIRE_CODEC=json (the
-// mixed-cluster escape hatch and the interop-test lever).
+// Authenticator-mode offer in the 1.3.0 hello's "auth" list, the lane
+// tag width, and the MAC domain-separation label (mirrored by
+// pbft_tpu/net/secure.py AUTH_MODE_MAC / MAC_TAG_LEN / MAC_CONTEXT;
+// constants lint).
+inline constexpr const char* kAuthModeMac = "mac1";
+inline constexpr size_t kMacTagLen = 16;
+inline constexpr const char* kMacContext = "pbft-tpu-auth1|";
+
+// The hello this node sends: kProtocolVersion with codecs ["bin2"] (and
+// auth ["mac1"] when the fast path asked for it), the 1.2.0 hello under
+// PBFT_PROTO_CAP=1.2.0, or the legacy 1.0.0 JSON-only hello when
+// PBFT_WIRE_CODEC=json (the mixed-cluster escape hatches and the
+// interop-test levers).
 const char* wire_hello_version();
 bool wire_offer_binary();
+// Whether this node's hellos offer MAC mode: the config asked for it
+// AND nothing capped the advertised protocol below 1.3.0.
+bool wire_offer_mac(bool fastpath_mac);
 // True when a peer's hello offers the binary-v2 codec (and this node
 // offers it too): the sender may then encode hot messages as binary.
 bool hello_offers_binary(const Json& obj);
+// True when a peer's hello offers the MAC authenticator mode; callers
+// AND it with their own offer.
+bool hello_offers_mac(const Json& obj);
+
+// One authenticator lane: keyed BLAKE2b(kMacContext || signable digest)
+// under a 32-byte per-link session key. Byte-identical to
+// net/secure.py mac_tag.
+void mac_tag(const uint8_t key[32], const uint8_t signable[32],
+             uint8_t out[kMacTagLen]);
+// Constant-time lane comparison.
+bool mac_tag_equal(const uint8_t a[kMacTagLen], const uint8_t b[kMacTagLen]);
 
 // Keystream/tag primitive: sealed = ciphertext || 16B tag. key is 64 bytes
 // (enc 32 || mac 32); ctr is the per-direction frame counter.
@@ -64,9 +94,14 @@ class SecureChannel {
  public:
   // expected_peer = the dialed replica id (initiator side), or -1 to learn
   // the peer id from its authenticated handshake frame (responder side).
+  // offer_mac: this node's hellos offer the MAC authenticator mode.
+  // auth_only: run the SAME signed handshake purely for key agreement +
+  // identity (the fastpath=mac, secure=false flavor) — frames on the
+  // link stay plaintext and callers must not seal/open through it.
   SecureChannel(const ClusterConfig* cfg, int64_t my_id,
                 const uint8_t identity_seed[32], bool initiator,
-                int64_t expected_peer = -1);
+                int64_t expected_peer = -1, bool offer_mac = false,
+                bool auth_only = false);
 
   // Initiator's first frame payload.
   std::string initiator_hello();
@@ -85,11 +120,20 @@ class SecureChannel {
   bool established() const { return established_; }
   int64_t peer_id() const { return peer_id_; }
   const std::string& error() const { return error_; }
+  // Fast-path negotiation surface (ISSUE 14): auth-only flavor, the
+  // peer's hello offer, both-sides-offered, and the per-direction
+  // session keys (valid once established).
+  bool auth_only() const { return auth_only_; }
+  bool mac_negotiated() const {
+    return wire_offer_mac(offer_mac_) && peer_offers_mac_;
+  }
+  const uint8_t* auth_send_key() const { return auth_send_key_; }
+  const uint8_t* auth_recv_key() const { return auth_recv_key_; }
 
   // {"type":"reject","reason":...,"ver":...} payload for clean refusal.
   static std::string reject_payload(const std::string& reason);
   // Version-check-only hello for plaintext clusters.
-  static std::string plain_hello(int64_t my_id);
+  static std::string plain_hello(int64_t my_id, bool offer_mac = false);
   // Shared version gate; sets *err on mismatch.
   static bool check_version(const Json& obj, std::string* err);
 
@@ -110,9 +154,14 @@ class SecureChannel {
   bool have_peer_eph_ = false;
   uint8_t send_key_[64];
   uint8_t recv_key_[64];
+  uint8_t auth_send_key_[32];
+  uint8_t auth_recv_key_[32];
   uint64_t send_ctr_ = 0;
   uint64_t recv_ctr_ = 0;
   bool established_ = false;
+  bool offer_mac_ = false;
+  bool auth_only_ = false;
+  bool peer_offers_mac_ = false;
   // The transcript binds to the INITIATOR's advertised version (both
   // sides know it after hello_i), so 1.1.0 <-> 1.0.0 handshakes agree on
   // the signed bytes. Initiator: the version it sent; responder: set

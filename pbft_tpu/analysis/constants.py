@@ -61,9 +61,49 @@ PAIRS: List[Tuple[str, Tuple[str, str], Tuple[str, str]]] = [
     ("binary max batch",
      ("core/messages.cc", "kBinMaxBatch"),
      ("pbft_tpu/consensus/messages.py", "_BIN_MAX_BATCH")),
+    # MAC-vector frame variants (ISSUE 14): the five authenticated
+    # codes, the lane-vector bound, the tag length, the KDF/domain
+    # labels, and the auth-mode offer name — one byte of drift here and
+    # a mixed-runtime mac link rejects every frame.
+    ("binary tag: pre-prepare (MAC)",
+     ("core/messages.cc", "kBinPrePrepareMac"),
+     ("pbft_tpu/consensus/messages.py", "_BIN_PRE_PREPARE_MAC")),
+    ("binary tag: prepare (MAC)",
+     ("core/messages.cc", "kBinPrepareMac"),
+     ("pbft_tpu/consensus/messages.py", "_BIN_PREPARE_MAC")),
+    ("binary tag: commit (MAC)",
+     ("core/messages.cc", "kBinCommitMac"),
+     ("pbft_tpu/consensus/messages.py", "_BIN_COMMIT_MAC")),
+    ("binary tag: checkpoint (MAC)",
+     ("core/messages.cc", "kBinCheckpointMac"),
+     ("pbft_tpu/consensus/messages.py", "_BIN_CHECKPOINT_MAC")),
+    ("binary tag: batched pre-prepare (MAC)",
+     ("core/messages.cc", "kBinPrePrepareBatchMac"),
+     ("pbft_tpu/consensus/messages.py", "_BIN_PRE_PREPARE_BATCH_MAC")),
+    ("MAC vector bound",
+     ("core/messages.cc", "kMacVectorMax"),
+     ("pbft_tpu/consensus/messages.py", "_MAC_VECTOR_MAX")),
+    ("MAC tag length",
+     ("core/secure.h", "kMacTagLen"),
+     ("pbft_tpu/net/secure.py", "MAC_TAG_LEN")),
+    ("MAC domain-separation label",
+     ("core/secure.h", "kMacContext"),
+     ("pbft_tpu/net/secure.py", "MAC_CONTEXT")),
+    ("MAC auth-mode offer name",
+     ("core/secure.h", "kAuthModeMac"),
+     ("pbft_tpu/net/secure.py", "AUTH_MODE_MAC")),
+    # Tentative-reply flag (ISSUE 14): the signed JSON member both
+    # runtimes omit-when-zero — a renamed/mis-cased field would fork
+    # every tentative reply's signable bytes.
+    ("tentative-reply field tag",
+     ("core/messages.h", "kTentativeField"),
+     ("pbft_tpu/consensus/messages.py", "TENTATIVE_FIELD")),
     ("protocol version (current)",
      ("core/secure.h", "kProtocolVersion"),
      ("pbft_tpu/net/secure.py", "PROTOCOL_VERSION")),
+    ("protocol version (batch)",
+     ("core/secure.h", "kProtocolVersionBatch"),
+     ("pbft_tpu/net/secure.py", "PROTOCOL_VERSION_BATCH")),
     ("protocol version (bin2)",
      ("core/secure.h", "kProtocolVersionBin2"),
      ("pbft_tpu/net/secure.py", "PROTOCOL_VERSION_BIN2")),
@@ -113,6 +153,14 @@ PAIRS: List[Tuple[str, Tuple[str, str], Tuple[str, str]]] = [
     ("ClusterConfig default: net_threads",
      ("core/replica.h", "net_threads"),
      ("pbft_tpu/consensus/config.py", "net_threads")),
+    # Fast-path modes (ISSUE 14): a sparse network.json must mean
+    # signature mode + committed-only replies in both runtimes.
+    ("ClusterConfig default: fastpath",
+     ("core/replica.h", "fastpath"),
+     ("pbft_tpu/consensus/config.py", "fastpath")),
+    ("ClusterConfig default: tentative",
+     ("core/replica.h", "tentative"),
+     ("pbft_tpu/consensus/config.py", "tentative")),
     # ISSUE 12: forwarded-request retention (view-change re-aim) bound —
     # same eviction point in both runtimes or their storm behavior forks.
     ("forwarded-request retention bound",
@@ -161,6 +209,12 @@ def files_scanned() -> List[str]:
 
 def _parse_cxx_value(raw: str) -> Optional[Value]:
     raw = raw.strip()
+    # bool defaults (e.g. `bool tentative = false;`): compare as 0/1 —
+    # Python-side `False` literals extract as bool, and False == 0.
+    if raw == "false":
+        return 0
+    if raw == "true":
+        return 1
     m = re.fullmatch(r'"([^"]*)"', raw)
     if m:
         return m.group(1)
@@ -342,9 +396,9 @@ def _check_status_magic(root: pathlib.Path, errors: List[str]) -> None:
 
 
 def _check_version_set(root: pathlib.Path, errors: List[str]) -> None:
-    """secure.py's _COMPATIBLE_VERSIONS must be exactly the three version
+    """secure.py's _COMPATIBLE_VERSIONS must be exactly the four version
     constants (which the pairwise checks pin to the C++ spellings); the
-    C++ compatible set in secure.cc is the same three names by check."""
+    C++ compatible set in secure.cc is the same four names by check."""
     path = root / "pbft_tpu/net/secure.py"
     tree = ast.parse(path.read_text())
     consts = {}
@@ -363,6 +417,7 @@ def _check_version_set(root: pathlib.Path, errors: List[str]) -> None:
             names = [e.id for e in node.value.elts if isinstance(e, ast.Name)]
             compatible = {consts.get(n) for n in names}
     want = {consts.get("PROTOCOL_VERSION"),
+            consts.get("PROTOCOL_VERSION_BATCH"),
             consts.get("PROTOCOL_VERSION_BIN2"),
             consts.get("PROTOCOL_VERSION_LEGACY")}
     if compatible is None:
@@ -372,13 +427,13 @@ def _check_version_set(root: pathlib.Path, errors: List[str]) -> None:
     elif compatible != want:
         errors.append(
             f"constants: version set: _COMPATIBLE_VERSIONS {compatible} != "
-            f"the three protocol versions {want}")
-    # C++ side: secure.cc must admit exactly the three named constants.
+            f"the four protocol versions {want}")
+    # C++ side: secure.cc must admit exactly the four named constants.
     scc = (root / "core/secure.cc")
     if scc.exists():
         text = scc.read_text()
-        for name in ("kProtocolVersion", "kProtocolVersionBin2",
-                     "kProtocolVersionLegacy"):
+        for name in ("kProtocolVersion", "kProtocolVersionBatch",
+                     "kProtocolVersionBin2", "kProtocolVersionLegacy"):
             if not re.search(r"ver\s*!=\s*" + name, text):
                 errors.append(
                     f"constants: version set: secure.cc compatible-set check "

@@ -69,6 +69,17 @@ class ClusterConfig:
     # parallelism lives in the JAX mesh, not the socket layer. The
     # default is constants-linted against core/replica.h.
     net_threads: int = 1
+    # Fast-path modes (ISSUE 14, protocol 1.3.0; defaults constants-linted
+    # against core/replica.h). fastpath = "mac" makes this node OFFER the
+    # per-link MAC-vector authenticator mode in its hellos — normal-case
+    # frames on links where BOTH sides offered it are authenticated by
+    # session MACs instead of hot-path signature verification (signatures
+    # are still minted: they are the evidence view changes re-verify).
+    # tentative = True makes replicas execute and reply once PREPARED
+    # (before commit; Castro–Liskov §5.3) with rollback on view change —
+    # clients then accept a 2f+1 matching tentative-reply quorum.
+    fastpath: str = "sig"
+    tentative: bool = False
     verifier: str = "cpu"  # "cpu" | "tpu"
     # Encrypted replica-replica links (signed-ephemeral DH + AEAD framing,
     # pbft_tpu/net/secure.py) — the reference's development_transport
@@ -102,6 +113,8 @@ class ClusterConfig:
                 "admission_inflight": self.admission_inflight,
                 "admission_backlog": self.admission_backlog,
                 "net_threads": self.net_threads,
+                "fastpath": self.fastpath,
+                "tentative": self.tentative,
                 "verifier": self.verifier,
                 "secure": self.secure,
                 "replicas": [dataclasses.asdict(r) for r in self.replicas],
@@ -124,6 +137,8 @@ class ClusterConfig:
             admission_inflight=d.get("admission_inflight", 0),
             admission_backlog=d.get("admission_backlog", 0),
             net_threads=d.get("net_threads", 1),
+            fastpath=d.get("fastpath", "sig"),
+            tentative=bool(d.get("tentative", False)),
             verifier=d.get("verifier", "cpu"),
             secure=bool(d.get("secure", False)),
         )

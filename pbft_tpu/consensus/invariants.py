@@ -38,7 +38,14 @@ from __future__ import annotations
 
 from typing import Callable, Dict, Iterable, List, Optional, Set, Tuple
 
-from .messages import Checkpoint, ClientRequest, Commit
+from .messages import (
+    Checkpoint,
+    ClientRequest,
+    Commit,
+    NewView,
+    Prepare,
+    PrePrepare,
+)
 from .simulation import Cluster
 
 
@@ -68,6 +75,15 @@ class InvariantChecker:
         self.cluster = cluster
         self._faulty_now = faulty or (lambda: set(cluster.faults))
         self.ever_faulty: Set[int] = set()
+        # Tentative mode (ISSUE 14): executions above the committed
+        # floor may legitimately ROLL BACK on a view change, so the
+        # checker keys its honesty rules to the floor — S1 compares the
+        # chain digest AT committed_upto (the tentative suffix is
+        # allowed to diverge transiently), executed_upto may decrease
+        # back to the floor, and S3 accepts a 2f+1 PREPARED certificate
+        # (pre-prepare + prepares from distinct senders) as the quorum
+        # behind a tentative execution.
+        self.tentative = bool(getattr(cluster.config, "tentative", False))
         # S1 evidence: rid -> {seq: chain digest hex observed there}.
         self.digest_at: Dict[int, Dict[int, str]] = {
             r.id: {} for r in cluster.replicas
@@ -75,10 +91,16 @@ class InvariantChecker:
         self._last_executed: Dict[int, int] = {
             r.id: r.executed_upto for r in cluster.replicas
         }
+        self._last_committed: Dict[int, int] = {
+            r.id: r.committed_upto for r in cluster.replicas
+        }
         # S3 evidence from sent messages: (view, seq, digest) -> commit
-        # senders; (seq, digest) -> checkpoint senders.
+        # senders; (seq, digest) -> checkpoint senders; (view, seq,
+        # digest) -> prepared-certificate senders (prepares + the
+        # pre-prepare standing in for the primary's prepare).
         self.commit_senders: Dict[Tuple[int, int, str], Set[int]] = {}
         self.checkpoint_senders: Dict[Tuple[int, str], Set[int]] = {}
+        self.prepare_senders: Dict[Tuple[int, int, str], Set[int]] = {}
         # S2 evidence: (rid, client, timestamp) -> result.
         self._reply_results: Dict[Tuple[int, str, int], str] = {}
         self._replies_seen = 0
@@ -96,6 +118,34 @@ class InvariantChecker:
                 self.checkpoint_senders.setdefault(
                     (msg.seq, msg.digest), set()
                 ).add(src)
+            elif isinstance(msg, Prepare):
+                self.prepare_senders.setdefault(
+                    (msg.view, msg.seq, msg.digest), set()
+                ).add(src)
+            elif isinstance(msg, PrePrepare):
+                # The primary's pre-prepare stands in for its prepare
+                # (§4.2) — it completes the 2f+1 prepared certificate.
+                self.prepare_senders.setdefault(
+                    (msg.view, msg.seq, msg.digest), set()
+                ).add(src)
+            elif isinstance(msg, NewView):
+                # A new primary's re-issued pre-prepares ride INSIDE the
+                # NEW-VIEW broadcast (never as standalone sends): they
+                # are its prepare-equivalent vote for every re-issued
+                # slot — without this, every tentative execution right
+                # after a view change looks one voter short.
+                for ppd in msg.pre_prepares:
+                    if not isinstance(ppd, dict):
+                        continue
+                    view = ppd.get("view")
+                    seq = ppd.get("seq")
+                    digest = ppd.get("digest")
+                    if isinstance(view, int) and isinstance(seq, int) and (
+                        isinstance(digest, str)
+                    ):
+                        self.prepare_senders.setdefault(
+                            (view, seq, digest), set()
+                        ).add(src)
 
         cluster.sent_observer = observe
 
@@ -127,6 +177,16 @@ class InvariantChecker:
             prev = self._last_executed[rid]
             cur = r.executed_upto
             if cur < prev:
+                # Tentative mode: a rollback to (at or above) the
+                # committed floor is the §5.3 view-change contract, not
+                # a violation — the rolled-back suffix's S1 evidence
+                # dies with it.
+                if self.tentative and cur >= r.committed_upto:
+                    self._last_executed[rid] = cur
+                    da = self.digest_at[rid]
+                    for seq in [s for s in da if s > cur]:
+                        del da[seq]
+                    continue
                 if rid in honest:
                     self._fail(
                         "executed-monotonic",
@@ -135,25 +195,46 @@ class InvariantChecker:
                 self._last_executed[rid] = cur
                 continue
             if cur == prev:
+                self._observe_committed(r)
                 continue
             self._last_executed[rid] = cur
             # S1 evidence: the chain digest observed at executed_upto=cur.
-            self.digest_at[rid][cur] = r.state_digest.hex()
+            # In tentative mode the executed suffix may roll back, so the
+            # cross-replica comparison keys on the COMMITTED chain
+            # instead (see _observe_committed); the executed-point digest
+            # is still recorded for the committed-catches-up case below.
+            if not self.tentative:
+                self.digest_at[rid][cur] = r.state_digest.hex()
+            self._observe_committed(r)
             if rid not in honest:
                 continue
             # S3: each newly executed sequence must be quorum-justified.
             for seq in range(prev + 1, cur + 1):
                 if self._committed_with_quorum(r, seq, quorum):
                     continue
+                if self.tentative and self._prepared_with_quorum(seq, quorum):
+                    continue  # tentative execution: prepared certificate
                 self._fail(
                     "executed-without-quorum",
-                    f"replica {rid} executed seq {seq} with no 2f+1 commit "
-                    f"or checkpoint evidence",
+                    f"replica {rid} executed seq {seq} with no 2f+1 commit"
+                    f"/checkpoint{'/prepared' if self.tentative else ''} "
+                    f"evidence",
                 )
         # S1: prefix agreement across every honest pair with a common seq.
         self._check_agreement(honest)
         # S2: exactly-once on the reply stream (incremental scan).
         self._check_replies(honest)
+
+    def _observe_committed(self, r) -> None:
+        """Tentative mode's S1 feed: record the chain digest AT the
+        committed floor whenever it advances — the part of the chain
+        that can never roll back is what honest replicas must agree on."""
+        if not self.tentative:
+            return
+        cur = r.committed_upto
+        if cur > self._last_committed[r.id] and cur > 0:
+            self._last_committed[r.id] = cur
+            self.digest_at[r.id][cur] = r.committed_chain.hex()
 
     def _committed_with_quorum(self, replica, seq: int, quorum: int) -> bool:
         # Normal case: 2f+1 distinct commit senders on one digest at seq.
@@ -163,6 +244,15 @@ class InvariantChecker:
         # State-transfer case: a certified checkpoint at or beyond seq.
         for (s, digest), senders in self.checkpoint_senders.items():
             if s >= seq and len(senders) >= quorum:
+                return True
+        return False
+
+    def _prepared_with_quorum(self, seq: int, quorum: int) -> bool:
+        """Tentative-execution justification: 2f+1 distinct senders of a
+        prepared certificate (prepares + the primary's pre-prepare) on
+        one digest at seq."""
+        for (view, s, digest), senders in self.prepare_senders.items():
+            if s == seq and len(senders) >= quorum:
                 return True
         return False
 
@@ -182,6 +272,12 @@ class InvariantChecker:
     def _check_replies(self, honest: Set[int]) -> None:
         replies = self.cluster.client_replies
         for rep in replies[self._replies_seen :]:
+            if self.tentative and getattr(rep, "tentative", 0):
+                # A tentative reply may be superseded by a different
+                # result after a rollback (the client's 2f+1 rule is
+                # what makes ACCEPTED results durable) — exactly-once is
+                # enforced on the committed reply stream.
+                continue
             key = (rep.replica, rep.client, rep.timestamp)
             prev = self._reply_results.get(key)
             if prev is None:
@@ -199,18 +295,31 @@ class InvariantChecker:
     def unreplied(
         self, submitted: Iterable[ClientRequest], f: Optional[int] = None
     ) -> List[ClientRequest]:
-        """L1 probe: the submitted requests still lacking f+1 matching
-        replies from distinct replicas. Empty list == liveness satisfied."""
+        """L1 probe: the submitted requests still lacking their reply
+        quorum from distinct replicas — f+1 matching COMMITTED replies,
+        or (tentative mode, ISSUE 14) 2f+1 matching replies overall.
+        Empty list == liveness satisfied."""
         f = self.cluster.config.f if f is None else f
         votes: Dict[Tuple[str, int], Dict[str, Set[int]]] = {}
+        committed_votes: Dict[Tuple[str, int], Dict[str, Set[int]]] = {}
         for rep in self.cluster.client_replies:
             votes.setdefault((rep.client, rep.timestamp), {}).setdefault(
                 rep.result, set()
             ).add(rep.replica)
+            if not getattr(rep, "tentative", 0):
+                committed_votes.setdefault(
+                    (rep.client, rep.timestamp), {}
+                ).setdefault(rep.result, set()).add(rep.replica)
         missing = []
         for req in submitted:
-            by_result = votes.get((req.client, req.timestamp), {})
-            if not any(len(s) >= f + 1 for s in by_result.values()):
+            key = (req.client, req.timestamp)
+            done = any(
+                len(s) >= f + 1
+                for s in committed_votes.get(key, {}).values()
+            ) or any(
+                len(s) >= 2 * f + 1 for s in votes.get(key, {}).values()
+            )
+            if not done:
                 missing.append(req)
         return missing
 

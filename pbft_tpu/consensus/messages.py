@@ -148,13 +148,27 @@ class ClientRequest(Message):
         return blake2b_256(self.canonical()).hex()
 
 
+# The tentative-reply flag's JSON member name (ISSUE 14; mirrors
+# core/messages.h kTentativeField, constants lint). Omitted when zero so
+# committed replies stay byte-identical to pre-1.3.0 peers.
+TENTATIVE_FIELD = "tentative"
+
+
 @dataclasses.dataclass(frozen=True)
 class ClientReply(Message):
     """Reply dialed back to the client (reference src/message.rs:55-72),
     signed by the replying replica: PBFT §4.1's f+1 reply quorum only
     means something if a vote proves which replica cast it — unsigned
     replies let one faulty party mint arbitrary votes on the dial-back
-    channel."""
+    channel.
+
+    ``tentative`` (ISSUE 14): 1 when the replica executed the request at
+    *prepared* (before commit, Castro–Liskov §5.3 tentative execution) —
+    the client needs 2f+1 matching tentative votes instead of f+1
+    committed ones. Part of the SIGNED content (a forgeable flag would
+    let a man-in-the-middle upgrade tentative votes to committed ones);
+    omitted from the canonical encoding when 0, so committed replies are
+    byte-identical to pre-1.3.0 replies."""
 
     TYPE: ClassVar[str] = "client-reply"
     view: int
@@ -163,6 +177,13 @@ class ClientReply(Message):
     replica: int
     result: str
     sig: str = ""
+    tentative: int = 0
+
+    def to_dict(self) -> dict:
+        d = super().to_dict()
+        if not d.get(TENTATIVE_FIELD):
+            d.pop(TENTATIVE_FIELD, None)
+        return d
 
 
 def batch_digest(requests) -> str:
@@ -496,6 +517,38 @@ _BIN_CHECKPOINT = 0x05
 _BIN_PRE_PREPARE_BATCH = 0x06
 _BIN_MAX_BATCH = 1 << 16
 
+# MAC-vector authenticated frame variants (ISSUE 14, protocol 1.3.0;
+# byte-identical to core/messages.cc — constants lint pins the codes):
+#
+#   0xB2 | mac_code | <base fields, sig included> |
+#       count x (rid:u8 | tag:16B) | count:u8
+#
+# The base fields are EXACTLY the signature variant's (the Ed25519
+# signature rides along — it is the evidence view changes re-verify
+# inline; what MAC mode removes is every hot-path signature
+# VERIFICATION). The lane vector holds one 16-byte keyed-BLAKE2b tag per
+# intended receiver, each under that (sender, receiver) link's session
+# key, so ONE encoded payload fans out to every peer (serialize-once)
+# and each receiver checks only its own lane. The count byte sits LAST
+# so a receiver finds its lane in O(count) from the frame tail without
+# re-parsing the variable-length field region.
+_BIN_PRE_PREPARE_MAC = 0x12
+_BIN_PREPARE_MAC = 0x13
+_BIN_COMMIT_MAC = 0x14
+_BIN_CHECKPOINT_MAC = 0x15
+_BIN_PRE_PREPARE_BATCH_MAC = 0x16
+_MAC_VECTOR_MAX = 64
+
+# mac code <-> the base (signature-variant) code it wraps.
+_MAC_TO_BASE = {
+    _BIN_PRE_PREPARE_MAC: _BIN_PRE_PREPARE,
+    _BIN_PREPARE_MAC: _BIN_PREPARE,
+    _BIN_COMMIT_MAC: _BIN_COMMIT,
+    _BIN_CHECKPOINT_MAC: _BIN_CHECKPOINT,
+    _BIN_PRE_PREPARE_BATCH_MAC: _BIN_PRE_PREPARE_BATCH,
+}
+_BASE_TO_MAC = {base: mac for mac, base in _MAC_TO_BASE.items()}
+
 
 def _i64(v: int) -> bytes:
     return v.to_bytes(8, "big", signed=True)
@@ -578,6 +631,66 @@ def to_binary(msg: Message) -> Optional[bytes]:
     return None
 
 
+def to_binary_mac(msg: Message, lanes) -> Optional[bytes]:
+    """MAC-vector frame for a hot message: the signature-variant fields
+    plus one (receiver id, 16-byte tag) lane per entry in ``lanes``
+    (an iterable of ``(rid, tag16)``; the caller computes tags with
+    net.secure.mac_tag over the message's signable digest). None when
+    the message has no binary form, lanes are empty/over the bound, or
+    a lane is malformed — the caller falls back to the signature frame."""
+    base = to_binary(msg)
+    if base is None:
+        return None
+    mac_code = _BASE_TO_MAC.get(base[1])
+    if mac_code is None:
+        return None
+    entries = list(lanes)
+    if not entries or len(entries) > _MAC_VECTOR_MAX:
+        return None
+    vec = bytearray()
+    for rid, tag in entries:
+        if not (isinstance(rid, int) and 0 <= rid <= 0xFF):
+            return None
+        if not isinstance(tag, (bytes, bytearray)) or len(tag) != 16:
+            return None
+        vec.append(rid)
+        vec += tag
+    return (
+        bytes((WIRE_BINARY_MAGIC, mac_code))
+        + base[2:]
+        + bytes(vec)
+        + len(entries).to_bytes(1, "big")
+    )
+
+
+def payload_is_mac_frame(payload: bytes) -> bool:
+    return (
+        len(payload) >= 2
+        and payload[0] == WIRE_BINARY_MAGIC
+        and payload[1] in _MAC_TO_BASE
+    )
+
+
+def mac_frame_lane(payload: bytes, rid: int) -> Optional[bytes]:
+    """This receiver's 16-byte authenticator tag from a MAC frame's lane
+    vector, or None (not a MAC frame, malformed vector, or no lane for
+    ``rid`` — e.g. a link that joined mid-fan-out; the caller then falls
+    back to the signature path, which the embedded sig still serves)."""
+    if not payload_is_mac_frame(payload):
+        return None
+    count = payload[-1]
+    if not (1 <= count <= _MAC_VECTOR_MAX):
+        return None
+    start = len(payload) - 1 - 17 * count
+    if start < 2:
+        return None
+    for k in range(count):
+        off = start + 17 * k
+        if payload[off] == rid:
+            return payload[off + 1 : off + 17]
+    return None
+
+
 class _BinReader:
     __slots__ = ("b", "off")
 
@@ -608,11 +721,23 @@ class _BinReader:
 
 def from_binary(payload: bytes) -> Message:
     """Decode a binary-v2 payload; raises ValueError on any malformation
-    (short reads, trailing bytes, unknown type, invalid UTF-8)."""
+    (short reads, trailing bytes, unknown type, invalid UTF-8). MAC
+    frame variants decode to the same Message as their signature twins —
+    the lane vector is validated structurally here and verified
+    cryptographically by the net layer (which holds the link keys)."""
     if len(payload) < 2 or payload[0] != WIRE_BINARY_MAGIC:
         raise ValueError("not a binary-v2 payload")
-    r = _BinReader(payload, 2)
     code = payload[1]
+    if code in _MAC_TO_BASE:
+        count = payload[-1]
+        if not (1 <= count <= _MAC_VECTOR_MAX):
+            raise ValueError("bad MAC-vector count")
+        end = len(payload) - 1 - 17 * count
+        if end < 2:
+            raise ValueError("truncated MAC-vector frame")
+        payload = bytes((WIRE_BINARY_MAGIC, _MAC_TO_BASE[code])) + payload[2:end]
+        code = payload[1]
+    r = _BinReader(payload, 2)
     if code == _BIN_CLIENT_REQUEST:
         msg: Message = ClientRequest(
             operation=r.str_(), timestamp=r.i64(), client=r.str_()

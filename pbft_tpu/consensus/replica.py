@@ -155,6 +155,11 @@ def host_batch_verify(items):
     return _HOST_BATCH_VERIFIER(items)
 
 
+def _strip_tentative(d: dict) -> dict:
+    d.pop("tentative", None)
+    return d
+
+
 def default_app(operation: str, seq: int) -> str:
     """The reference's execution is a no-op with a hardcoded result
     (reference src/message.rs:70); kept as the default app."""
@@ -195,6 +200,23 @@ class Replica:
         self.last_reply: Dict[str, ClientReply] = {}
         self.checkpoints: Dict[int, Dict[int, Checkpoint]] = {}
         self.state_digest = blake2b_256(b"pbft-genesis")
+        # Tentative execution (ISSUE 14, Castro–Liskov §5.3; active when
+        # config.tentative). committed_upto <= executed_upto is the
+        # highest sequence whose whole prefix is committed-local AND
+        # executed — everything above it ran tentatively (at prepared)
+        # and can roll back on a view change. Per executed sequence above
+        # the floor, _tentative_undo holds what execution changed (prior
+        # chain digest, per-request prior timestamp/reply cache entries,
+        # app snapshot); _pending_checkpoints holds checkpoint payloads
+        # captured at execution time whose EMISSION waits for the commit
+        # point (a checkpoint may only cover state that cannot roll
+        # back); committed_chain is the chain digest AT the committed
+        # floor (what the invariant checker compares across replicas).
+        self.committed_upto = 0
+        self.committed_chain = self.state_digest
+        self._tentative_undo: Dict[int, dict] = {}
+        self._committed_seqs: Set[int] = set()
+        self._pending_checkpoints: Dict[int, str] = {}
         self.stable_proof: List[dict] = []  # 2f+1 checkpoint dicts @ low_mark
         # Checkpoint payloads we can serve to lagging peers (seq -> canonical
         # JSON, see _checkpoint_payload), and the (seq, digest) we are
@@ -259,6 +281,9 @@ class Replica:
         self.counters: Dict[str, int] = {
             "sig_verified": 0,
             "sig_rejected": 0,
+            "mac_verified": 0,
+            "tentative_executions": 0,
+            "tentative_rollbacks": 0,
             "pre_prepares_accepted": 0,
             "prepares_accepted": 0,
             "commits_accepted": 0,
@@ -288,10 +313,23 @@ class Replica:
     def has_unexecuted(self) -> bool:
         """True when accepted pre-prepares (or committed-but-unexecuted
         slots) sit above executed_upto — the runtime's request-timer
-        signal (mirrors core/replica.cc)."""
+        signal (mirrors core/replica.cc). In tentative mode an executed
+        but uncommitted suffix also counts: its commits are still owed,
+        and starving them must keep the timer armed."""
         if self.pending_execution:
             return True
+        if self.config.tentative and self.executed_upto > self.committed_upto:
+            return True
         return any(seq > self.executed_upto for _, seq in self.pre_prepares)
+
+    def progress_marker(self) -> int:
+        """What the runtime's view timer treats as progress: COMMITTED
+        sequences in tentative mode (tentative executions roll back, so
+        they must not placate the timer while commits starve), executed
+        sequences otherwise."""
+        return (
+            self.committed_upto if self.config.tentative else self.executed_upto
+        )
 
     def _sign(self, msg: Message) -> Message:
         return with_sig(msg, _host_sign(self._seed, msg.signable()).hex())
@@ -402,7 +440,7 @@ class Replica:
         pending_items reuses it instead of re-serializing."""
         if isinstance(msg, ClientRequest):
             return self.on_client_request(msg)
-        self._inbox.append((msg, signable))
+        self._inbox.append((msg, signable, False))
         return []
 
     def pending_count(self) -> int:
@@ -410,11 +448,35 @@ class Replica:
         accumulation window (config.verify_flush_us) polls this."""
         return len(self._inbox)
 
+    def _consume_inbox(self, verdicts: List[bool]):
+        """Split the inbox into (entry, ok) pairs covered by ``verdicts``
+        and the remainder: pre-authenticated entries pass for free (and
+        are consumed greedily at the tail), verification-needing entries
+        consume one verdict each, in arrival order."""
+        taken: List[Tuple[Message, bool, bool]] = []
+        vi = 0
+        consumed = 0
+        for msg, _signable, preauth in self._inbox:
+            if preauth:
+                taken.append((msg, True, True))
+            else:
+                if vi >= len(verdicts):
+                    break
+                taken.append((msg, verdicts[vi], False))
+                vi += 1
+            consumed += 1
+        self._inbox = self._inbox[consumed:]
+        return taken
+
     def pending_items(self) -> List[Tuple[bytes, bytes, bytes]]:
-        """(pubkey32, digest32, sig64) per queued message, for the batch
-        verifier (pbft_tpu.crypto.batch.verify_many or the TPU service)."""
+        """(pubkey32, digest32, sig64) per queued message NEEDING
+        verification, for the batch verifier (pre-authenticated entries —
+        MAC-accepted frames queued behind the signed types for ordering —
+        are skipped; deliver_verdicts treats them as already valid)."""
         items = []
-        for msg, signable in self._inbox:
+        for msg, signable, preauth in self._inbox:
+            if preauth:
+                continue
             rid = getattr(msg, "replica", None)
             pub = (
                 self.config.identity(rid).pubkey_bytes()
@@ -432,15 +494,37 @@ class Replica:
             items.append((pub, signable or msg.signable(), sig))
         return items
 
+    def receive_authenticated(self, msg: Message) -> List[Action]:
+        """Dispatch a message the NET layer already authenticated via its
+        per-link session MAC (ISSUE 14 authenticator mode): no signature
+        check — the MAC lane proved the sender, and the net layer checked
+        the claimed replica id against the link's authenticated peer.
+
+        ORDERING: when the verify inbox is non-empty the message queues
+        BEHIND it (pre-verified) instead of dispatching immediately — a
+        MAC frame overtaking a still-unverified NEW-VIEW from the same
+        sender would be dropped as belonging to a view this replica has
+        not entered yet, and the primary's per-view duplicate suppression
+        then pins the request until the NEXT view change (a liveness
+        wedge the chaos soak caught). The inbox only ever holds the rare
+        signed types in MAC mode, so the fast path stays fast."""
+        self.counters["mac_verified"] += 1
+        if isinstance(msg, ClientRequest):
+            return self.on_client_request(msg)
+        if self._inbox:
+            self._inbox.append((msg, None, True))
+            return []
+        return self._dispatch(msg)
+
     def deliver_verdicts(self, verdicts: List[bool]) -> List[Action]:
         """Resume processing for the queued messages, in arrival order."""
-        batch, self._inbox = self._inbox[: len(verdicts)], self._inbox[len(verdicts) :]
         out: List[Action] = []
-        for (msg, _), ok in zip(batch, verdicts):
+        for msg, ok, preauth in self._consume_inbox(verdicts):
             if not ok:
                 self.counters["sig_rejected"] += 1
                 continue
-            self.counters["sig_verified"] += 1
+            if not preauth:  # MAC-accepted entries counted at receive
+                self.counters["sig_verified"] += 1
             out.extend(self._dispatch(msg))
         return out
 
@@ -549,6 +633,15 @@ class Replica:
             Commit(view=key[0], seq=key[1], digest=pp.digest, replica=self.id)
         )
         out: List[Action] = [Broadcast(cm)]
+        if self.config.tentative:
+            # Tentative execution (§5.3): PREPARED is the execute point —
+            # the reply goes out one commit round-trip early, flagged
+            # tentative; the commit quorum later promotes it (and a view
+            # change before that rolls it back).
+            view, seq = key
+            if seq > self.executed_upto and seq not in self.pending_execution:
+                self.pending_execution[seq] = (view, pp.digest)
+                out.extend(self._drain_executions())
         out.extend(self._insert_commit(cm))
         return out
 
@@ -583,6 +676,15 @@ class Replica:
         if not self._committed_local(key):
             return []
         view, seq = key
+        if self.config.tentative and seq <= self.executed_upto:
+            # Already executed (tentatively) — the commit quorum arrived
+            # now: advance the committed floor. No "committed" phase
+            # stamp: the span already closed at the tentative execution,
+            # and a committed stamp after "executed" would violate the
+            # phase-order invariant the timeline checker enforces.
+            if seq <= self.committed_upto or seq in self._committed_seqs:
+                return []
+            return self._note_committed(seq)
         if seq <= self.executed_upto or seq in self.pending_execution:
             return []
         self.pending_execution[seq] = (view, self.pre_prepares[key].digest)
@@ -604,11 +706,34 @@ class Replica:
             if hook is not None:
                 hook("executed", view, seq)
             pp = self.pre_prepares.get((view, seq))
+            # Tentative mode: is this execution already backed by a
+            # commit quorum (definitive) or only by the prepared
+            # certificate (tentative — reply flagged, undo recorded)?
+            tentative_mode = self.config.tentative
+            committed_now = not tentative_mode or self._committed_local(
+                (view, seq)
+            )
+            undo: Optional[dict] = None
+            if tentative_mode:
+                # Undo record for EVERY executed sequence above the
+                # committed floor (committed-now ones included — the
+                # floor may still be below them, and rollback walks the
+                # whole suffix): prior chain digest, per-request prior
+                # exactly-once entries, app snapshot when stateful.
+                snap = getattr(self._app, "snapshot", None)
+                undo = {
+                    "chain": self.state_digest,
+                    "items": [],
+                    "app": snap() if callable(snap) else None,
+                }
+                self._tentative_undo[seq] = undo
             if pp is None:
                 # Defensive: can only happen if the pre-prepare log lost an
                 # entry for a slot that committed; the watermark-jump path
                 # (the old way to get here) now goes through state transfer
                 # (_on_state_response) instead of skipping executions.
+                if tentative_mode and committed_now:
+                    out.extend(self._note_committed(seq))
                 continue
             self.counters["rounds_executed"] += 1
             if not pp.requests:
@@ -636,6 +761,10 @@ class Replica:
                     # enforced per batch item in batch order.
                     self.counters["duplicate_requests"] += 1
                     continue
+                if undo is not None:
+                    undo["items"].append(
+                        (req.client, last, self.last_reply.get(req.client))
+                    )
                 result = self._app(req.operation, seq)
                 self.counters["executed"] += 1
                 self.state_digest = hashlib.blake2b(
@@ -653,23 +782,116 @@ class Replica:
                         client=req.client,
                         replica=self.id,
                         result=result,
+                        tentative=0 if committed_now else 1,
                     )
                 )
                 self.last_reply[req.client] = reply
                 out.append(Reply(req.client, reply))
             if seq % self.config.checkpoint_interval == 0:
                 payload = self._checkpoint_payload(seq)
-                self.snapshots[seq] = payload
+                if tentative_mode:
+                    # Deferred emission: the payload is captured NOW (the
+                    # state IS the state at seq) but the Checkpoint
+                    # message waits for the commit point — a checkpoint
+                    # may only ever cover state that cannot roll back.
+                    self._pending_checkpoints[seq] = payload
+                else:
+                    self.snapshots[seq] = payload
+                    cp = self._sign(
+                        Checkpoint(
+                            seq=seq,
+                            digest=blake2b_256(payload.encode()).hex(),
+                            replica=self.id,
+                        )
+                    )
+                    out.append(Broadcast(cp))
+                    out.extend(self._insert_checkpoint(cp))
+            if tentative_mode:
+                if committed_now:
+                    out.extend(self._note_committed(seq))
+                else:
+                    self.counters["tentative_executions"] += 1
+        if not self.config.tentative:
+            # Signature mode: every execution is definitive — the floor
+            # tracks execution so the progress/metrics surface is uniform.
+            self.committed_upto = self.executed_upto
+            self.committed_chain = self.state_digest
+        return out
+
+    # -- tentative promotion & rollback (ISSUE 14, §5.3) --------------------
+
+    def _note_committed(self, seq: int) -> List[Action]:
+        """Sequence ``seq`` is committed-local AND executed: advance the
+        committed floor over every contiguously-committed sequence,
+        retire their undo records, refresh committed_chain, and emit any
+        checkpoint whose (deferred) interval boundary the floor crossed."""
+        if seq <= self.committed_upto:
+            return []
+        self._committed_seqs.add(seq)
+        out: List[Action] = []
+        while (self.committed_upto + 1) in self._committed_seqs:
+            self.committed_upto += 1
+            s = self.committed_upto
+            self._committed_seqs.discard(s)
+            self._tentative_undo.pop(s, None)
+            payload = self._pending_checkpoints.pop(s, None)
+            if payload is not None:
+                self.snapshots[s] = payload
                 cp = self._sign(
                     Checkpoint(
-                        seq=seq,
+                        seq=s,
                         digest=blake2b_256(payload.encode()).hex(),
                         replica=self.id,
                     )
                 )
                 out.append(Broadcast(cp))
                 out.extend(self._insert_checkpoint(cp))
+        nxt = self._tentative_undo.get(self.committed_upto + 1)
+        self.committed_chain = (
+            nxt["chain"] if nxt is not None else self.state_digest
+        )
         return out
+
+    def _rollback_tentative(self) -> None:
+        """Undo every execution above the committed floor, newest first
+        (view-change entry, or a certified checkpoint past the floor):
+        chain digest, per-client exactly-once timestamps, cached replies,
+        and app state all revert to the committed point; the re-issued
+        sequences then re-prepare, re-commit, and re-execute in the new
+        view. Clients that accepted a reply are safe regardless: 2f+1
+        matching tentative votes imply f+1 HONEST replicas holding the
+        full prepared certificate, and any new-view quorum intersects
+        them — the same batch is re-issued at the same sequence."""
+        if not self.config.tentative or self.executed_upto <= self.committed_upto:
+            return
+        rolled = 0
+        for seq in range(self.executed_upto, self.committed_upto, -1):
+            undo = self._tentative_undo.pop(seq, None)
+            self._pending_checkpoints.pop(seq, None)
+            self._committed_seqs.discard(seq)
+            if undo is None:
+                continue  # defensive: every executed seq records one
+            self.state_digest = undo["chain"]
+            for client, prev_ts, prev_reply in reversed(undo["items"]):
+                if prev_ts is None:
+                    self.last_timestamp.pop(client, None)
+                else:
+                    self.last_timestamp[client] = prev_ts
+                if prev_reply is None:
+                    self.last_reply.pop(client, None)
+                else:
+                    self.last_reply[client] = prev_reply
+            if undo["app"] is not None:
+                restore = getattr(self._app, "restore", None)
+                if callable(restore):
+                    restore(undo["app"])
+            rolled += 1
+        self.executed_upto = self.committed_upto
+        self.committed_chain = self.state_digest
+        for s in [x for x in self.pending_execution if x > self.committed_upto]:
+            del self.pending_execution[s]
+        if rolled:
+            self.counters["tentative_rollbacks"] += rolled
 
     # -- checkpoints, watermarks & state transfer (PBFT §4.3, §5.3) ---------
 
@@ -689,9 +911,15 @@ class Replica:
             # The reply cache is replica-local in its `replica` and `sig`
             # fields; normalize both so all correct replicas digest
             # identical payload bytes (the restorer stamps its own id back
-            # in and re-signs).
+            # in and re-signs). The tentative flag is normalized away too:
+            # by the time a checkpoint at this seq is EMITTED the prefix
+            # is committed, and capture-time flag skew (one replica
+            # executed a seq tentatively, another already held the
+            # quorum) must not fork the certified payload bytes.
             "replies": [
-                [c, {**self.last_reply[c].to_dict(), "replica": -1, "sig": ""}]
+                [c, _strip_tentative(
+                    {**self.last_reply[c].to_dict(), "replica": -1, "sig": ""}
+                )]
                 for c in sorted(self.last_reply)
             ],
             "seq": seq,
@@ -750,6 +978,13 @@ class Replica:
         self.last_reply = replies
         self.last_timestamp = timestamps
         self.executed_upto = seq
+        # The fetched state is 2f+1-certified: the committed floor moves
+        # with it and any stale tentative bookkeeping dies here.
+        self.committed_upto = seq
+        self.committed_chain = chain
+        self._tentative_undo.clear()
+        self._committed_seqs.clear()
+        self._pending_checkpoints.clear()
         self.snapshots[seq] = resp.snapshot  # we can serve peers now
         self.awaiting_state = None
         self.counters["state_transfers"] += 1
@@ -761,6 +996,18 @@ class Replica:
         return self._insert_checkpoint(cp)
 
     def _insert_checkpoint(self, cp: Checkpoint) -> List[Action]:
+        # MAC mode (ISSUE 14): checkpoints were accepted by their link
+        # lane, but their embedded signatures are what stable-checkpoint
+        # CERTIFICATES (the C component of view changes, and the gate on
+        # state transfer) are made of — admit only provable evidence, or
+        # one sig-corrupting peer poisons every honest VIEW-CHANGE.
+        # Checkpoints are rare (one per interval per replica), so the
+        # inline verify costs nothing the fast path can feel; signature
+        # mode already verified upstream (fastpath gate keeps it free).
+        if self.config.fastpath == "mac" and not self._verify_inline(
+            cp.replica, cp.signable(), cp.sig
+        ):
+            return []
         slot = self.checkpoints.setdefault(cp.seq, {})
         if cp.replica in slot:
             return []
@@ -849,17 +1096,34 @@ class Replica:
     def _prepared_proofs(self) -> List[dict]:
         """P: for each sequence prepared above the low watermark, the
         pre-prepare plus its 2f matching backup prepares (highest view
-        wins when a sequence prepared in several views)."""
+        wins when a sequence prepared in several views).
+
+        Only evidence with VALID signatures ships (ISSUE 14): in MAC
+        mode the hot path accepts frames by their lane without checking
+        the embedded signature, so a sig-corrupting Byzantine peer can
+        place garbage-signature prepares in honest logs — shipping one
+        would make validators reject this replica's whole VIEW-CHANGE
+        (the liveness wedge the chaos soak caught). A slot that cannot
+        assemble a fully-valid certificate is simply not claimed: the
+        client's retransmission re-orders it in the new view. In
+        signature mode every logged message was already verified, so the
+        filter is a no-op."""
         best: Dict[int, Tuple[int, dict]] = {}
         for (view, seq), pp in self.pre_prepares.items():
             if seq <= self.low_mark or not self._prepared((view, seq)):
                 continue
             primary = self.config.primary_of(view)
+            if not self._verify_inline(primary, pp.signable(), pp.sig):
+                continue  # sig-corrupt primary: slot unprovable
             preps = [
                 p.to_dict()
                 for rid, p in self.prepares[(view, seq)].items()
-                if rid != primary and p.digest == pp.digest
+                if rid != primary
+                and p.digest == pp.digest
+                and self._verify_inline(p.replica, p.signable(), p.sig)
             ]
+            if len(preps) < 2 * self.config.f:
+                continue  # not enough valid-signature evidence
             entry = {"pre_prepare": pp.to_dict(), "prepares": preps}
             if seq not in best or view > best[seq][0]:
                 best[seq] = (view, entry)
@@ -1126,6 +1390,11 @@ class Replica:
         stable_cert: Optional[Tuple[str, List[dict]]],
         pps: List[PrePrepare],
     ) -> List[Action]:
+        # Tentative executions do not survive a view change (§5.3): roll
+        # the uncommitted suffix back BEFORE processing the new view's O
+        # — its re-issued pre-prepares re-run the three-phase protocol
+        # and re-execute whatever the quorum actually prepared.
+        self._rollback_tentative()
         self.view = v
         self.in_view_change = False
         self.pending_view = 0
@@ -1192,6 +1461,12 @@ class Replica:
     ) -> List[Action]:
         if stable_seq <= self.low_mark:
             return []
+        if self.config.tentative and stable_seq > self.committed_upto:
+            # A 2f+1 quorum checkpointed past our committed floor: the
+            # tentative suffix we hold may not match the certified chain
+            # — revert to the committed point and catch up through the
+            # certified state (the state-transfer branch below).
+            self._rollback_tentative()
         self.low_mark = stable_seq
         self.counters["checkpoints_stable"] += 1
         out: List[Action] = []

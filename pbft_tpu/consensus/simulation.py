@@ -21,6 +21,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 from ..crypto import ref as crypto
 from .config import ClusterConfig, make_local_cluster
 from .messages import (
+    Checkpoint,
     ClientReply,
     ClientRequest,
     Commit,
@@ -86,11 +87,23 @@ class Cluster:
         seeds: Optional[List[bytes]] = None,
         app=None,
         app_factory: Optional[Callable[[], Callable]] = None,
+        mode: str = "sig",
     ):
         if config is None:
             config, seeds = make_local_cluster(n)
         self.config = config
         self.seeds = seeds
+        # Fast-path authenticator mode (ISSUE 14): "mac" models the real
+        # runtimes' per-link session MACs — the transport KNOWS each
+        # message's true sender, so a hot-type message whose claimed
+        # replica matches the sending link dispatches pre-authenticated
+        # (receive_authenticated, no signature verification), an
+        # impersonating claim is dropped at the link (exactly what a
+        # lane-key mismatch does on the wire), and everything else
+        # (view-change/new-view/state evidence) still signature-verifies.
+        if mode not in ("sig", "mac"):
+            raise ValueError(f"unknown fast-path mode {mode!r}")
+        self.mode = mode
 
         def _app_kw():
             # app_factory gives each replica its OWN app instance — required
@@ -103,7 +116,12 @@ class Cluster:
         self.replicas = [
             Replica(config, i, seeds[i], **_app_kw()) for i in range(config.n)
         ]
-        self.inboxes: Dict[int, List[Message]] = {i: [] for i in range(config.n)}
+        # Inbox entries carry the TRUE link-level sender (src, message):
+        # the mac mode's authenticity model needs it, and the byte-
+        # faithful round trip still runs in _route.
+        self.inboxes: Dict[int, List[Tuple[int, Message]]] = {
+            i: [] for i in range(config.n)
+        }
         self.client_replies: List[ClientReply] = []
         self.rng = random.Random(seed)
         # The chaos layer draws from its OWN stream so enabling/disabling it
@@ -136,8 +154,8 @@ class Cluster:
         self.partitions: List[set] = []  # symmetric components; [] = whole
         self.default_chaos: Optional[LinkChaos] = None
         self.link_chaos: Dict[Tuple[int, int], LinkChaos] = {}
-        # Delayed deliveries: (deliver_at_step, tie_break, dst, Message).
-        self._in_flight: List[Tuple[int, int, int, Message]] = []
+        # Delayed deliveries: (deliver_at_step, tie_break, src, dst, Message).
+        self._in_flight: List[Tuple[int, int, int, int, Message]] = []
         self._flight_seq = 0
         # Per-replica history of sent messages, for the stutter mode.
         self._sent_history: Dict[int, List[Message]] = {}
@@ -231,7 +249,7 @@ class Cluster:
 
     def _route(self, src: int, dst: int, msg: Message) -> None:
         frame = to_wire(msg)  # byte-faithful round trip on every hop
-        self.inboxes[dst].append(from_wire(frame[4:]))
+        self.inboxes[dst].append((src, from_wire(frame[4:])))
 
     def _emit(self, src: int, actions) -> None:
         muted = self.faults.get(src) == "mute"
@@ -369,7 +387,7 @@ class Cluster:
             else:
                 self._flight_seq += 1
                 self._in_flight.append(
-                    (self.step_count + delay, self._flight_seq, dst, msg)
+                    (self.step_count + delay, self._flight_seq, src, dst, msg)
                 )
 
     def _inject_due(self) -> None:
@@ -379,11 +397,11 @@ class Cluster:
         for entry in self._in_flight:
             (due if entry[0] <= self.step_count else still).append(entry)
         self._in_flight = still
-        for _, _, dst, msg in sorted(due):
+        for _, _, src, dst, msg in sorted(due):
             if dst in self.crashed:
                 self.chaos_dropped += 1  # arrived at a dead replica
                 continue
-            self._route(dst, dst, msg)  # already fault/link-processed
+            self._route(src, dst, msg)  # already fault/link-processed
 
     # -- scheduler ----------------------------------------------------------
 
@@ -404,8 +422,21 @@ class Cluster:
             if self.shuffle:
                 self.rng.shuffle(queue)
             actions = []
-            for msg in queue:
-                actions.extend(replica.receive(msg))
+            for src, msg in queue:
+                if self.mode == "mac" and isinstance(
+                    msg, (PrePrepare, Prepare, Commit, Checkpoint)
+                ):
+                    # Authenticator mode: the link proves the sender. A
+                    # claim matching the sending link dispatches
+                    # pre-authenticated; an impersonating claim dies at
+                    # the link (the wire's lane-key mismatch). src == rid
+                    # is self/client delivery — always trusted.
+                    if src == rid or msg.replica == src:
+                        actions.extend(replica.receive_authenticated(msg))
+                    else:
+                        continue
+                else:
+                    actions.extend(replica.receive(msg))
             items = replica.pending_items()
             if items:
                 verdicts = self.verify(items)

@@ -286,3 +286,37 @@ def signable_from_payload(payload: bytes) -> Optional[bytes]:
     if not fn(payload, len(payload), digest):
         return None
     return digest.raw
+
+
+def message_to_binary_mac(payload: bytes, lanes) -> Optional[bytes]:
+    """Encode a JSON message payload as a native MAC-vector frame
+    (ISSUE 14): ``lanes`` is a sequence of (rid, 16-byte tag). None when
+    the type has no MAC form — the cross-runtime byte-parity surface."""
+    blob = b"".join(
+        rid.to_bytes(1, "big") + bytes(tag) for rid, tag in lanes
+    )
+    fn = lib().pbft_message_to_binary_mac
+    fn.restype = ctypes.c_size_t
+    out = ctypes.create_string_buffer(len(payload) + len(blob) + 256)
+    n = fn(payload, len(payload), blob, len(lanes), out, len(out))
+    if n == 0 or n > len(out):
+        return None
+    return out.raw[:n]
+
+
+def mac_frame_lane(payload: bytes, rid: int) -> Optional[bytes]:
+    """The C++ lane extraction for a MAC frame; None when absent."""
+    fn = lib().pbft_mac_frame_lane
+    fn.restype = ctypes.c_int
+    tag = ctypes.create_string_buffer(16)
+    if not fn(payload, len(payload), ctypes.c_longlong(rid), tag):
+        return None
+    return tag.raw
+
+
+def mac_tag(key: bytes, signable: bytes) -> bytes:
+    """The C++ authenticator-lane tag (net/secure.py mac_tag parity)."""
+    assert len(key) == 32 and len(signable) == 32
+    tag = ctypes.create_string_buffer(16)
+    lib().pbft_mac_tag(key, signable, tag)
+    return tag.raw

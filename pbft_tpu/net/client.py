@@ -375,6 +375,9 @@ class PbftClient:
                 replica=rid,
                 result=str(r["result"]),
                 sig=str(r["sig"]),
+                # Signed content (ISSUE 14): a flipped flag fails the
+                # signature check instead of upgrading a tentative vote.
+                tentative=int(r.get("tentative", 0)),
             )
             sig = bytes.fromhex(reply.sig)
             pub = bytes.fromhex(self.config.identity(rid).pubkey)
@@ -387,15 +390,20 @@ class PbftClient:
     def wait_result(
         self, timestamp: int, f: Optional[int] = None, timeout: float = 10.0
     ) -> str:
-        """Block until f+1 matching replies for `timestamp` arrive."""
+        """Block until a reply quorum for `timestamp` arrives: f+1
+        matching COMMITTED replies (PBFT §4.1), or — the ISSUE 14 fast
+        path — 2f+1 matching replies in one view when some are tentative
+        (Castro–Liskov §5.3: 2f+1 tentative votes imply f+1 honest
+        replicas holding the full prepared certificate, which every
+        new-view quorum intersects, so the outcome cannot roll back)."""
         f = self.config.f if f is None else f
         deadline = time.monotonic() + timeout
         with self._new_reply:
             while True:
-                # One vote per replica id (PBFT §4.1: f+1 replies from
+                # One vote per replica id (PBFT §4.1: replies from
                 # *different* replicas) — retransmitted/duplicated replies
                 # from a single replica must not satisfy the quorum.
-                votes: Dict[int, Tuple[str, int]] = {}
+                votes: Dict[int, Tuple[str, int, int]] = {}
                 for r in self.replies:
                     rid = r.get("replica")
                     if not isinstance(rid, int) or not 0 <= rid < self.config.n:
@@ -407,29 +415,47 @@ class PbftClient:
                     # the dial-back channel is otherwise forgeable.
                     if not self._reply_signature_valid(r, rid):
                         continue
-                    votes[rid] = (r.get("result"), r.get("view"))
+                    votes[rid] = (
+                        r.get("result"),
+                        r.get("view"),
+                        1 if r.get("tentative") else 0,
+                    )
                 by_result: Dict[Tuple[str, int], int] = {}
-                for key in votes.values():
-                    by_result[key] = by_result.get(key, 0) + 1
+                committed_by_result: Dict[str, int] = {}
+                for result, view, tentative in votes.values():
+                    by_result[(result, view)] = (
+                        by_result.get((result, view), 0) + 1
+                    )
+                    if not tentative:
+                        committed_by_result[result] = (
+                            committed_by_result.get(result, 0) + 1
+                        )
+                accepted: Optional[str] = None
                 for (result, _view), count in by_result.items():
-                    if count >= f + 1:
-                        # getattr: bare test doubles skip __init__.
-                        rec = getattr(self, "latency_log", {}).get(timestamp)
-                        if rec is not None and "quorum" not in rec:
-                            rec["quorum"] = time.monotonic()
-                            rxs = [
-                                r["_rx"]
-                                for r in self.replies
-                                if r.get("timestamp") == timestamp
-                                and "_rx" in r
-                            ]
-                            if rxs:
-                                rec["first_reply"] = min(rxs)
-                        return result
+                    if (
+                        count >= 2 * f + 1
+                        or committed_by_result.get(result, 0) >= f + 1
+                    ):
+                        accepted = result
+                        break
+                if accepted is not None:
+                    # getattr: bare test doubles skip __init__.
+                    rec = getattr(self, "latency_log", {}).get(timestamp)
+                    if rec is not None and "quorum" not in rec:
+                        rec["quorum"] = time.monotonic()
+                        rxs = [
+                            r["_rx"]
+                            for r in self.replies
+                            if r.get("timestamp") == timestamp
+                            and "_rx" in r
+                        ]
+                        if rxs:
+                            rec["first_reply"] = min(rxs)
+                    return accepted
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     raise TimeoutError(
-                        f"no f+1 reply quorum for t={timestamp}; "
+                        f"no reply quorum for t={timestamp}; "
                         f"got {by_result}"
                     )
                 self._new_reply.wait(remaining)

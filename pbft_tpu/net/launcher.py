@@ -72,6 +72,8 @@ class LocalCluster:
         admission_inflight: int = 0,
         admission_backlog: int = 0,
         net_threads: int = 1,
+        fastpath: str = "sig",
+        tentative: bool = False,
     ):
         self.trace_dir = trace_dir
         # Black-box flight recorders (ISSUE 9): each daemon dumps its last
@@ -140,6 +142,11 @@ class LocalCluster:
                 # event loop; the asyncio runtime accepts the key and
                 # stays single-loop.
                 net_threads=net_threads,
+                # Fast-path modes (ISSUE 14): the MAC authenticator
+                # offer and tentative execution, read identically by
+                # both runtimes from network.json.
+                fastpath=fastpath,
+                tentative=tentative,
             )
         self.config = config
         self.seeds = seeds

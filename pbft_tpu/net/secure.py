@@ -52,34 +52,68 @@ from ..crypto import ref
 # 1.1.0 adds the negotiated binary-v2 payload codec
 # (consensus/messages.py); 1.2.0 adds the batched pre-prepare (binary
 # 0x06 / JSON `requests`, ISSUE 4) whose batch=1 frames stay
-# byte-identical to 1.1.0. Older peers stay interoperable — the hello's
-# ver gates what a sender may offer, the handshake transcript binds to
+# byte-identical to 1.1.0; 1.3.0 adds the fast-path modes (ISSUE 14):
+# per-link session-MAC authenticators on normal-case frames (the
+# MAC-vector binary variants, consensus/messages.py 0x12-0x16) and the
+# tentative client-reply flag. Older peers stay interoperable — the
+# hello's ver gates what a sender may offer (a link only runs MAC mode
+# when BOTH hellos offered "mac1"), the handshake transcript binds to
 # the initiator's advertised version so mixed-version secure handshakes
 # still agree on the signed bytes, and a batching primary simply must
 # not be pointed at pre-1.2.0 peers with batch_max_items > 1.
-PROTOCOL_VERSION = "pbft-tpu/1.2.0"
+PROTOCOL_VERSION = "pbft-tpu/1.3.0"
+PROTOCOL_VERSION_BATCH = "pbft-tpu/1.2.0"
 PROTOCOL_VERSION_BIN2 = "pbft-tpu/1.1.0"
 PROTOCOL_VERSION_LEGACY = "pbft-tpu/1.0.0"
 _COMPATIBLE_VERSIONS = (
     PROTOCOL_VERSION,
+    PROTOCOL_VERSION_BATCH,
     PROTOCOL_VERSION_BIN2,
     PROTOCOL_VERSION_LEGACY,
 )
+
+# The authenticator-mode offer carried in the 1.3.0 hello's "auth" list
+# (mirrors core/secure.h kAuthModeMac; constants lint): per-link session
+# MACs over the signable digest, keys derived from the handshake
+# transcript. MAC_TAG_LEN and MAC_CONTEXT are the tag width and the
+# domain-separation label (core/secure.h kMacTagLen / kMacContext).
+AUTH_MODE_MAC = "mac1"
+MAC_TAG_LEN = 16
+MAC_CONTEXT = "pbft-tpu-auth1|"
 
 
 def _wire_json_forced() -> bool:
     return os.environ.get("PBFT_WIRE_CODEC") == "json"
 
 
+def _proto_capped_12() -> bool:
+    """PBFT_PROTO_CAP=1.2.0 advertises the 1.2.0 hello with no fast-path
+    offer — the interop-test lever simulating a pre-1.3.0 peer (the same
+    role PBFT_WIRE_CODEC=json plays for 1.0.0)."""
+    return os.environ.get("PBFT_PROTO_CAP") == "1.2.0"
+
+
 def wire_hello_version() -> str:
-    """The version this node advertises: 1.1.0 with the binary-codec
-    offer, or the legacy 1.0.0 JSON-only hello when PBFT_WIRE_CODEC=json
-    (the mixed-cluster escape hatch and the interop-test lever)."""
-    return PROTOCOL_VERSION_LEGACY if _wire_json_forced() else PROTOCOL_VERSION
+    """The version this node advertises: 1.3.0 with the codec + fast-path
+    offers, 1.2.0 under PBFT_PROTO_CAP=1.2.0, or the legacy 1.0.0
+    JSON-only hello when PBFT_WIRE_CODEC=json (the mixed-cluster escape
+    hatches and the interop-test levers)."""
+    if _wire_json_forced():
+        return PROTOCOL_VERSION_LEGACY
+    if _proto_capped_12():
+        return PROTOCOL_VERSION_BATCH
+    return PROTOCOL_VERSION
 
 
 def wire_offer_binary() -> bool:
     return not _wire_json_forced()
+
+
+def wire_offer_mac(fastpath_mac: bool) -> bool:
+    """Whether this node's hellos offer the MAC authenticator mode: the
+    cluster config asked for it (fastpath == "mac") AND nothing capped
+    the advertised protocol below 1.3.0."""
+    return fastpath_mac and not _wire_json_forced() and not _proto_capped_12()
 
 
 def hello_offers_binary(obj: dict) -> bool:
@@ -91,10 +125,30 @@ def hello_offers_binary(obj: dict) -> bool:
     return isinstance(codecs, list) and CODEC_BINARY2 in codecs
 
 
-def _attach_codecs(o: dict) -> dict:
+def hello_offers_mac(obj: dict) -> bool:
+    """True when a peer's hello offers the MAC authenticator mode. The
+    caller still ANDs this with its own offer — a link runs MAC frames
+    only when both sides advertised mac1."""
+    auth = obj.get("auth")
+    return isinstance(auth, list) and AUTH_MODE_MAC in auth
+
+
+def _attach_codecs(o: dict, offer_mac: bool = False) -> dict:
     if wire_offer_binary():
         o["codecs"] = [CODEC_BINARY2]
+    if wire_offer_mac(offer_mac):
+        o["auth"] = [AUTH_MODE_MAC]
     return o
+
+
+def mac_tag(key: bytes, signable_digest: bytes) -> bytes:
+    """One authenticator lane: keyed BLAKE2b over the domain label + the
+    32-byte signable digest (the same bytes a signature would cover).
+    Byte-identical to core/secure.cc mac_tag."""
+    return hashlib.blake2b(
+        MAC_CONTEXT.encode() + signable_digest, key=key,
+        digest_size=MAC_TAG_LEN,
+    ).digest()
 _HS_CONTEXT = b"pbft-tpu-hs1|"
 _KDF_CONTEXT = b"pbft-tpu-k1|"
 TAG_LEN = 16
@@ -150,6 +204,24 @@ def derive_keys(shared: bytes, eph_i: bytes, eph_r: bytes) -> Tuple[bytes, bytes
         ).digest()
 
     return kdf(b"i2r"), kdf(b"r2i")
+
+
+def derive_auth_keys(
+    shared: bytes, eph_i: bytes, eph_r: bytes
+) -> Tuple[bytes, bytes]:
+    """(auth_i2r, auth_r2i): 32 bytes each — the per-direction session
+    keys behind the ISSUE 14 MAC-vector authenticators. Derived from the
+    SAME handshake transcript material as the AEAD keys but under
+    distinct labels, so authenticator lanes and frame sealing never share
+    key bytes. Byte-identical to core/secure.cc derive_key("a-i2r"...)."""
+    def kdf(label: bytes) -> bytes:
+        return hashlib.blake2b(
+            _KDF_CONTEXT + label + b"|" + eph_i + b"|" + eph_r,
+            key=shared,
+            digest_size=32,
+        ).digest()
+
+    return kdf(b"a-i2r"), kdf(b"a-r2i")
 
 
 def seal(key: bytes, ctr: int, plaintext: bytes) -> bytes:
@@ -223,6 +295,8 @@ class SecureChannel:
         initiator: bool,
         expected_peer: Optional[int] = None,
         eph_secret: Optional[bytes] = None,
+        offer_mac: bool = False,
+        auth_only: bool = False,
     ):
         self.my_id = my_id
         self._seed = identity_seed
@@ -237,6 +311,18 @@ class SecureChannel:
         self._send_ctr = 0
         self._recv_ctr = 0
         self.established = False
+        # Fast-path negotiation (ISSUE 14): whether THIS node offers the
+        # MAC authenticator mode, whether the peer's hello offered it,
+        # and the per-direction session keys once established.
+        # ``auth_only`` marks a channel that runs the SAME signed
+        # handshake purely for key agreement + identity — frames on the
+        # link stay plaintext (the fastpath=mac, secure=false flavor);
+        # callers must not seal/open through an auth-only channel.
+        self.offer_mac = offer_mac
+        self.auth_only = auth_only
+        self.peer_offers_mac = False
+        self.auth_send_key: Optional[bytes] = None
+        self.auth_recv_key: Optional[bytes] = None
         # The transcript binds to the INITIATOR's advertised version
         # (both sides know it after hello_i): initiator = the version it
         # sends; responder = set from hello_i in on_hello.
@@ -251,7 +337,8 @@ class SecureChannel:
                 "ver": wire_hello_version(),
                 "node": self.my_id,
                 "eph": self.eph_pub.hex(),
-            }
+            },
+            offer_mac=self.offer_mac,
         )
 
     @staticmethod
@@ -279,6 +366,9 @@ class SecureChannel:
         k_i2r, k_r2i = derive_keys(shared, eph_i, eph_r)
         self._send_key = k_i2r if self.initiator else k_r2i
         self._recv_key = k_r2i if self.initiator else k_i2r
+        a_i2r, a_r2i = derive_auth_keys(shared, eph_i, eph_r)
+        self.auth_send_key = a_i2r if self.initiator else a_r2i
+        self.auth_recv_key = a_r2i if self.initiator else a_i2r
         self.established = True
 
     def _verify_peer_sig(self, obj: dict, label: bytes) -> None:
@@ -308,6 +398,7 @@ class SecureChannel:
         # check_version admitted the initiator's version into the
         # compatible set; the transcript binds to it.
         self._hs_version = obj["ver"]
+        self.peer_offers_mac = hello_offers_mac(obj)
         self._peer_eph = _hex_field(obj, "eph", 32)
         sig = ref.sign(self._seed, self._transcript() + b"|resp")
         return _attach_codecs(
@@ -317,7 +408,8 @@ class SecureChannel:
                 "node": self.my_id,
                 "eph": self.eph_pub.hex(),
                 "sig": sig.hex(),
-            }
+            },
+            offer_mac=self.offer_mac,
         )
 
     def on_hello_reply(self, obj: dict) -> dict:
@@ -327,6 +419,7 @@ class SecureChannel:
         self.check_version(obj)
         if not isinstance(obj.get("eph"), str):
             raise HandshakeError("responder hello carried no ephemeral key")
+        self.peer_offers_mac = hello_offers_mac(obj)
         self._peer_eph = _hex_field(obj, "eph", 32)
         self._verify_peer_sig(obj, b"|resp")
         sig = ref.sign(self._seed, self._transcript() + b"|init")
@@ -339,6 +432,11 @@ class SecureChannel:
             raise HandshakeError("auth before hello")
         self._verify_peer_sig(obj, b"|init")
         self._finish()
+
+    @property
+    def mac_negotiated(self) -> bool:
+        """Both sides offered the MAC authenticator mode on this link."""
+        return wire_offer_mac(self.offer_mac) and self.peer_offers_mac
 
     # -- sealed frames ------------------------------------------------------
 
@@ -362,10 +460,11 @@ def reject_payload(reason: str) -> dict:
     return {"type": "reject", "reason": reason, "ver": wire_hello_version()}
 
 
-def plain_hello(my_id: int) -> dict:
+def plain_hello(my_id: int, offer_mac: bool = False) -> dict:
     """The version-carrying (and codec-offering) hello sent on plaintext
     peer links — both as the dialing side's first frame and as the
     responder's hello-ack that lets the dialer negotiate binary-v2."""
     return _attach_codecs(
-        {"type": "hello", "ver": wire_hello_version(), "node": my_id}
+        {"type": "hello", "ver": wire_hello_version(), "node": my_id},
+        offer_mac=offer_mac,
     )

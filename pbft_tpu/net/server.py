@@ -30,6 +30,8 @@ import time
 from typing import Callable, Dict, List, Optional, Tuple
 
 from ..consensus.config import ClusterConfig
+import hmac
+
 from ..consensus.messages import (
     ClientReply,
     ClientRequest,
@@ -38,8 +40,11 @@ from ..consensus.messages import (
     batch_digest,
     decode_payload,
     from_wire,
+    mac_frame_lane,
+    payload_is_mac_frame,
     signable_from_payload,
     to_binary,
+    to_binary_mac,
     with_sig,
 )
 from ..consensus.replica import (
@@ -64,14 +69,18 @@ class _PeerLink:
     on plaintext links), and the negotiated payload codec. ``binary``
     flips when the peer's hello (plaintext hello-ack or secure hello_r)
     offers the binary-v2 codec; frames sent before that go as JSON —
-    receivers detect the codec per frame."""
+    receivers detect the codec per frame. ``mac`` (ISSUE 14): both sides
+    offered the authenticator mode on this link, so hot messages go out
+    as MAC-vector frames (the link's send lane key lives in the server's
+    _mac_send_keys table, feeding the shared per-broadcast vector)."""
 
-    __slots__ = ("writer", "chan", "binary")
+    __slots__ = ("writer", "chan", "binary", "mac")
 
-    def __init__(self, writer, chan=None, binary=False):
+    def __init__(self, writer, chan=None, binary=False, mac=False):
         self.writer = writer
         self.chan = chan
         self.binary = binary
+        self.mac = mac
 
 
 class _EncodedOut:
@@ -84,13 +93,18 @@ class _EncodedOut:
     against the broadcast count (encodes == broadcasts, never
     broadcasts x peers)."""
 
-    __slots__ = ("msg", "_json", "_binary", "_binary_tried", "_server")
+    __slots__ = (
+        "msg", "_json", "_binary", "_binary_tried", "_mac", "_mac_tried",
+        "_server",
+    )
 
     def __init__(self, msg: Message, server=None):
         self.msg = msg
         self._json: Optional[bytes] = None
         self._binary: Optional[bytes] = None
         self._binary_tried = False
+        self._mac: Optional[bytes] = None
+        self._mac_tried = False
         self._server = server
 
     def _count(self) -> None:
@@ -114,6 +128,30 @@ class _EncodedOut:
             if self._binary is not None:
                 self._count()
         return self._binary
+
+    def mac_payload(self, keys: Dict[int, bytes]) -> Optional[bytes]:
+        """The MAC-vector frame (ISSUE 14), computed AT MOST ONCE per
+        broadcast: one lane per peer in ``keys`` (the sender-side session
+        keys of every mac-negotiated link), all over the message's
+        signable digest — the serialize-once invariant extended to the
+        authenticator mode. A peer whose link joins mid-fan-out misses
+        its lane and falls back to signature verification (the sig rides
+        in the frame), so staleness costs a signature check, never a
+        drop. None when the type has no MAC form (or no mac links yet)."""
+        if not self._mac_tried:
+            self._mac_tried = True
+            if keys:
+                digest = self.msg.signable()
+                self._mac = to_binary_mac(
+                    self.msg,
+                    [
+                        (rid, secure.mac_tag(key, digest))
+                        for rid, key in sorted(keys.items())
+                    ],
+                )
+                if self._mac is not None:
+                    self._count()
+        return self._mac
 
 
 def _frame_obj(obj: dict) -> bytes:
@@ -309,6 +347,19 @@ class AsyncReplicaServer:
         self.vc_timeout = vc_timeout
         self.secure = config.secure
         self._seed = seed
+        # Fast-path modes (ISSUE 14): whether this node OFFERS the MAC
+        # authenticator mode in its hellos (config.fastpath == "mac",
+        # unless an env lever capped the advertised protocol), the
+        # per-dest sender-side lane keys of every mac-negotiated link
+        # (feeding the shared per-broadcast MAC vector), and the frame
+        # tallies. Tentative execution is config-driven inside Replica;
+        # the runtime only stamps its flight/metrics surface.
+        self.fastpath_mac = secure.wire_offer_mac(config.fastpath == "mac")
+        self._mac_send_keys: Dict[int, bytes] = {}
+        self.mac_frames = 0
+        self.mac_rejected = 0
+        self._seen_tentative = 0
+        self._seen_rollbacks = 0
         self.discovery_target = discovery
         self._discovery = None
         self._warned_no_discovery = False
@@ -621,6 +672,10 @@ class AsyncReplicaServer:
                             else:
                                 secure.SecureChannel.check_version(obj)
                                 hello_seen = True
+                                peer_mac = (
+                                    self.fastpath_mac
+                                    and secure.hello_offers_mac(obj)
+                                )
                                 if obj.get("role") == "gateway":
                                     # Gateway trust (ISSUE 10, parity with
                                     # core/net.cc): framed client requests
@@ -645,6 +700,28 @@ class AsyncReplicaServer:
                                         self._seed,
                                         self._pubkey_of,
                                         initiator=False,
+                                        offer_mac=self.fastpath_mac,
+                                    )
+                                    reply = chan.on_hello(obj)
+                                    writer.write(_frame_obj(reply))
+                                    await writer.drain()
+                                elif peer_mac and isinstance(
+                                    obj.get("eph"), str
+                                ):
+                                    # Authenticator mode on a plaintext
+                                    # cluster (ISSUE 14): run the SAME
+                                    # signed station-to-station handshake
+                                    # purely for lane-key agreement +
+                                    # peer identity — frames after it
+                                    # stay plaintext (auth-only channel,
+                                    # never sealed/opened).
+                                    chan = secure.SecureChannel(
+                                        self.id,
+                                        self._seed,
+                                        self._pubkey_of,
+                                        initiator=False,
+                                        offer_mac=self.fastpath_mac,
+                                        auth_only=True,
                                     )
                                     reply = chan.on_hello(obj)
                                     writer.write(_frame_obj(reply))
@@ -656,7 +733,12 @@ class AsyncReplicaServer:
                                     # (a 1.0.0 initiator parses and
                                     # ignores any non-reject frame).
                                     writer.write(
-                                        _frame_obj(secure.plain_hello(self.id))
+                                        _frame_obj(
+                                            secure.plain_hello(
+                                                self.id,
+                                                offer_mac=self.fastpath_mac,
+                                            )
+                                        )
                                     )
                                     await writer.drain()
                                 continue
@@ -679,7 +761,7 @@ class AsyncReplicaServer:
                         except (ConnectionError, OSError):
                             pass
                         return
-                if chan is not None:
+                if chan is not None and not chan.auth_only:
                     try:
                         payload = chan.open_frame(payload)
                     except secure.HandshakeError:
@@ -699,7 +781,15 @@ class AsyncReplicaServer:
                         self.metrics_registry.counter(
                             "pbft_gateway_forwarded_total"
                         ).inc()
-                self._ingest(msg, payload)
+                if (
+                    chan is not None
+                    and chan.established
+                    and chan.mac_negotiated
+                    and payload_is_mac_frame(payload)
+                ):
+                    self._ingest_mac(msg, payload, chan)
+                else:
+                    self._ingest(msg, payload)
         finally:
             if gw_link_id is not None:
                 self._gateway_links.pop(gw_link_id, None)
@@ -792,6 +882,35 @@ class AsyncReplicaServer:
                 self._dial_line(req.client, payload + b"\n")
             )
         return True
+
+    def _ingest_mac(self, msg: Message, payload: bytes, chan) -> None:
+        """One MAC-vector frame off an authenticator-mode link: verify
+        this replica's lane against the link's session key and the
+        message's claimed sender against the link's authenticated peer,
+        then dispatch WITHOUT the verify queue (the whole point — zero
+        hot-path signature verification). A frame with no lane for us
+        (link joined mid-fan-out) falls back to the signature path the
+        embedded sig still serves; a lane MISMATCH is dropped and
+        counted (a tampered or replayed-across-links frame)."""
+        lane = mac_frame_lane(payload, self.id)
+        if lane is None:
+            self._ingest(msg, payload)
+            return
+        expected = secure.mac_tag(
+            chan.auth_recv_key, signable_from_payload(payload, msg)
+        )
+        if not hmac.compare_digest(lane, expected) or (
+            getattr(msg, "replica", None) != chan.peer_id
+        ):
+            self.mac_rejected += 1
+            return
+        self.frames_in += 1
+        if self.metrics_registry.enabled:
+            self.metrics_registry.counter("pbft_frames_in_total").inc()
+        actions = self.replica.receive_authenticated(msg)
+        if actions:
+            self._emit(actions)
+        self._batch_wakeup.set()
 
     def _ingest(self, msg: Message, payload: Optional[bytes] = None) -> None:
         self.frames_in += 1
@@ -1054,6 +1173,14 @@ class AsyncReplicaServer:
                     self.flight.record(
                         "reply_tx", view=act.msg.view, seq=act.msg.timestamp
                     )
+                    if act.msg.tentative:
+                        # Fast-path coverage (ISSUE 14): the reply left
+                        # at PREPARED, one commit round-trip early.
+                        self.flight.record(
+                            "tentative_reply",
+                            view=act.msg.view,
+                            seq=act.msg.timestamp,
+                        )
                 tracer = get_tracer()
                 if tracer.enabled:
                     tracer.event(
@@ -1070,7 +1197,29 @@ class AsyncReplicaServer:
                     self._gateway_reply(act.client, act.msg)
                 else:
                     loop.create_task(self._dial_reply(act.client, act.msg))
+        # Tentative-execution surface (ISSUE 14): counter deltas + the
+        # rollback flight record (a rollback is a rare, load-bearing
+        # event — exactly what the black box exists to capture).
+        t_roll = self.replica.counters["tentative_rollbacks"]
+        if t_roll > self._seen_rollbacks:
+            if self.flight is not None:
+                self.flight.record(
+                    "tentative_rollback",
+                    view=self.replica.view,
+                    seq=t_roll - self._seen_rollbacks,
+                )
+            if self.metrics_registry.enabled:
+                self.metrics_registry.counter(
+                    "pbft_tentative_rollbacks_total"
+                ).inc(t_roll - self._seen_rollbacks)
+            self._seen_rollbacks = t_roll
         if self.metrics_registry.enabled:
+            t_exec = self.replica.counters["tentative_executions"]
+            if t_exec > self._seen_tentative:
+                self.metrics_registry.counter(
+                    "pbft_tentative_executions_total"
+                ).inc(t_exec - self._seen_tentative)
+                self._seen_tentative = t_exec
             # Deltas of the replica's own counters: "executed" counts per
             # REQUEST, "rounds_executed" per sequence number — together
             # the batch amplification (requests per three-phase instance).
@@ -1113,7 +1262,7 @@ class AsyncReplicaServer:
             reader, writer = await asyncio.open_connection(host, port)
         except OSError:
             return None  # peer down: PBFT tolerates f of these
-        if not self.secure:
+        if not self.secure and not self.fastpath_mac:
             writer.write(_frame_obj(secure.plain_hello(self.id)))
             # A version-mismatched responder answers with a reject frame,
             # and a 1.1.0 responder answers with its own hello (the codec
@@ -1124,17 +1273,43 @@ class AsyncReplicaServer:
                 self._watch_link(dest, reader, link)
             )
             return link
+        # Secure link handshake — or, in authenticator mode on a
+        # plaintext cluster, the SAME signed handshake run auth-only
+        # (lane-key agreement + peer identity; frames stay plaintext).
         chan = secure.SecureChannel(
             self.id,
             self._seed,
             self._pubkey_of,
             initiator=True,
             expected_peer=dest,
+            offer_mac=self.fastpath_mac,
+            auth_only=not self.secure,
         )
         try:
             writer.write(_frame_obj(chan.initiator_hello()))
             await writer.drain()
             reply = json.loads(await _read_frame(reader))
+            if not self.secure and not (
+                isinstance(reply, dict) and isinstance(reply.get("eph"), str)
+            ):
+                # A plaintext responder that answered the mac-offering
+                # hello with a classic hello-ack (pre-1.3.0, or
+                # signature-mode config): downgrade this link to the
+                # plain flavor — its ack still carried the codec offer.
+                if (
+                    isinstance(reply, dict)
+                    and reply.get("type") == "reject"
+                ):
+                    raise secure.HandshakeError(
+                        f"peer rejected handshake: {reply.get('reason')}"
+                    )
+                link = _PeerLink(
+                    writer, binary=secure.hello_offers_binary(reply)
+                )
+                asyncio.get_running_loop().create_task(
+                    self._watch_link(dest, reader, link)
+                )
+                return link
             auth = chan.on_hello_reply(reply)
             writer.write(_frame_obj(auth))
             await writer.drain()
@@ -1156,8 +1331,20 @@ class AsyncReplicaServer:
         # close after the handshake must drop the cached link immediately,
         # not linger until the next write fails (silently losing one send).
         # hello_r carried the responder's codec offer: binary-v2 from here
-        # on when both sides speak it.
-        link = _PeerLink(writer, chan, binary=secure.hello_offers_binary(reply))
+        # on when both sides speak it — and the mac offer (ISSUE 14): a
+        # mutually-offered link registers its sender-side lane key so
+        # broadcasts grow a lane for this peer.
+        mac = chan.mac_negotiated
+        if mac:
+            self._mac_send_keys[dest] = chan.auth_send_key
+        else:
+            self._mac_send_keys.pop(dest, None)
+        link = _PeerLink(
+            writer,
+            chan if self.secure else None,
+            binary=secure.hello_offers_binary(reply),
+            mac=mac,
+        )
         asyncio.get_running_loop().create_task(
             self._watch_link(dest, reader, link)
         )
@@ -1236,13 +1423,29 @@ class AsyncReplicaServer:
                 if link is None:
                     return
                 self._peer_links[dest] = link
-            payload = enc.binary_payload() if link.binary else None
+            payload = None
+            mac_frame = False
+            if link.mac:
+                # Authenticator mode: the shared MAC-vector frame — one
+                # encode + one lane set per broadcast, every mac link
+                # ships the same bytes (its receiver verifies its lane
+                # instead of the hot-path signature).
+                payload = enc.mac_payload(self._mac_send_keys)
+                mac_frame = payload is not None
+            if payload is None and link.binary:
+                payload = enc.binary_payload()
             if payload is not None:
                 self.codec_binary_frames += 1
                 if self.metrics_registry.enabled:
                     self.metrics_registry.counter(
                         "pbft_codec_binary_frames_total"
                     ).inc()
+                if mac_frame:
+                    self.mac_frames += 1
+                    if self.metrics_registry.enabled:
+                        self.metrics_registry.counter(
+                            "pbft_mac_frames_total"
+                        ).inc()
             else:
                 payload = enc.json_payload()
                 self.codec_json_frames += 1
@@ -1370,7 +1573,10 @@ class AsyncReplicaServer:
                 continue
             state = self._vc_policy.poll(
                 now,
-                self.replica.executed_upto,
+                # Tentative mode: progress = COMMITTED sequences, so a
+                # commit-starved cluster still escalates (tentative
+                # executions roll back — they must not placate the timer).
+                self.replica.progress_marker(),
                 self.replica.view,
                 self.replica.in_view_change,
             )
@@ -1473,6 +1679,13 @@ class AsyncReplicaServer:
             "view_timer_backoff": self._vc_policy.level,
             "faults_injected": self.faults_injected,
             "chaos_dropped": self.chaos_dropped,
+            # Fast-path surface (ISSUE 14): the negotiated-offer mode,
+            # tentative execution, MAC frame tallies, committed floor.
+            "mode": "mac" if self.fastpath_mac else "sig",
+            "tentative": self.config.tentative,
+            "mac_frames": self.mac_frames,
+            "mac_rejected": self.mac_rejected,
+            "committed_upto": self.replica.committed_upto,
             "executed_upto": self.replica.executed_upto,
             "low_mark": self.replica.low_mark,
             "view": self.replica.view,
@@ -1493,6 +1706,12 @@ async def _amain(args, config_text: str, flight=None) -> None:
         config = _dc.replace(config, batch_max_items=args.batch_max_items)
     if args.batch_flush_us is not None and args.batch_flush_us >= 0:
         config = _dc.replace(config, batch_flush_us=args.batch_flush_us)
+    # Fast-path overrides (ISSUE 14), mirroring pbftd --fastpath /
+    # --tentative: network.json stays the default source of truth.
+    if args.fastpath:
+        config = _dc.replace(config, fastpath=args.fastpath)
+    if args.tentative:
+        config = _dc.replace(config, tentative=True)
     server = AsyncReplicaServer(
         config,
         args.id,
@@ -1545,6 +1764,22 @@ def main() -> None:
         default=None,
         help="how long a partial batch may wait for more requests before "
         "the runtime seals it (overrides network.json batch_flush_us)",
+    )
+    parser.add_argument(
+        "--fastpath",
+        default="",
+        choices=("", "sig", "mac"),
+        help="fast-path authenticator mode (ISSUE 14): 'mac' offers "
+        "per-link session-MAC authentication of normal-case frames in "
+        "this node's hellos (overrides network.json fastpath); links "
+        "whose peer did not offer it fall back to signature mode",
+    )
+    parser.add_argument(
+        "--tentative",
+        action="store_true",
+        help="execute + reply at PREPARED (tentative, ISSUE 14) with "
+        "rollback on view change; clients need 2f+1 matching tentative "
+        "votes (overrides network.json tentative=false)",
     )
     parser.add_argument(
         "--metrics-port",

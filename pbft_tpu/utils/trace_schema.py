@@ -245,6 +245,17 @@ METRIC_SCHEMAS = {
         "counter",
         {"gateway.py", "server.py", "net.cc"},
     ),
+    # Fast-path surface (ISSUE 14, protocol 1.3.0). MAC frames: outbound
+    # normal-case frames authenticated by a per-link session-MAC vector
+    # instead of hot-path signature verification (zero in signature mode
+    # and against pre-1.3.0 peers). Tentative executions: sequences
+    # executed at PREPARED (one commit round-trip early); rollbacks:
+    # tentative sequences undone by a view change / certified-checkpoint
+    # catch-up — nonzero rollbacks with zero client-visible divergence is
+    # exactly the §5.3 story the chaos matrix checks.
+    "pbft_mac_frames_total": ("counter", {"server.py", "net.cc"}),
+    "pbft_tentative_executions_total": ("counter", {"server.py", "net.cc"}),
+    "pbft_tentative_rollbacks_total": ("counter", {"server.py", "net.cc"}),
     "pbft_batch_size": ("histogram", {"server.py", "net.cc"}),
     "pbft_verify_batch_size": ("histogram", {"server.py", "service.py", "net.cc"}),
     "pbft_verify_seconds": ("histogram", {"server.py", "service.py", "net.cc"}),
@@ -303,6 +314,11 @@ FLIGHT_EVENTS = {
     12: "backoff_level",
     13: "overload_rejected",
     14: "gateway_failover",
+    # Fast-path coverage (ISSUE 14): a reply left at PREPARED (seq = the
+    # request timestamp), and a tentative-suffix rollback on view change
+    # / certified-checkpoint catch-up (seq = sequences rolled back).
+    15: "tentative_reply",
+    16: "tentative_rollback",
 }
 FLIGHT_EVENT_IDS = {name: i for i, name in FLIGHT_EVENTS.items()}
 

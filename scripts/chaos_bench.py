@@ -105,6 +105,7 @@ async def drive_identity(
     deadline_s: float,
     latencies_ms: list,
     stats: dict,
+    tentative_quorum: int = 0,
 ) -> int:
     """One client identity with GATEWAY FAILOVER: pipeline ``window``
     requests, count completion at ``quorum`` distinct-replica matching
@@ -222,11 +223,31 @@ async def drive_identity(
                     rid = obj.get("replica")
                     if not isinstance(rid, int):
                         continue
-                    st["votes"][rid] = (obj.get("result"), obj.get("view"))
+                    st["votes"][rid] = (
+                        obj.get("result"),
+                        obj.get("view"),
+                        1 if obj.get("tentative") else 0,
+                    )
+                    # Committed replies complete at `quorum` (f+1)
+                    # matching; tentative ones (ISSUE 14 fast path) need
+                    # `tentative_quorum` (2f+1) matching in one view.
                     by_result: dict = {}
-                    for key in st["votes"].values():
-                        by_result[key] = by_result.get(key, 0) + 1
-                    if max(by_result.values()) >= quorum:
+                    committed: dict = {}
+                    for result, view, tent in st["votes"].values():
+                        by_result[(result, view)] = (
+                            by_result.get((result, view), 0) + 1
+                        )
+                        if not tent:
+                            committed[result] = (
+                                committed.get(result, 0) + 1
+                            )
+                    ok = (
+                        committed and max(committed.values()) >= quorum
+                    ) or (
+                        tentative_quorum > 0
+                        and max(by_result.values()) >= tentative_quorum
+                    )
+                    if ok:
                         latencies_ms.append(
                             (time.monotonic() - st["send"]) * 1e3
                         )
@@ -274,6 +295,7 @@ async def drive_identity(
 
 async def run_load(
     host, ports, clients, requests_each, window, quorum, deadline_s,
+    tentative_quorum=0,
     token_prefix="cb", stats=None,
 ):
     latencies_ms: list = []
@@ -283,6 +305,7 @@ async def run_load(
             host, ports, i % len(ports),
             f"{GATEWAY_CLIENT_PREFIX}{token_prefix}-{i}", requests_each,
             window, quorum, retransmit_s=3.0, deadline_s=deadline_s,
+            tentative_quorum=tentative_quorum,
             latencies_ms=latencies_ms, stats=stats,
         )
         for i in range(clients)
@@ -425,7 +448,7 @@ class FaultSchedule(threading.Thread):
 def run_arm_traced(
     arm, n, clients, requests_each, window, batch, batch_flush_us, impl,
     gateways, vc_timeout_ms, admission_inflight, admission_backlog,
-    fault_at_s, heal_at_s, deadline_s, seed, blackbox_dir,
+    fault_at_s, heal_at_s, deadline_s, seed, blackbox_dir, mode="sig",
 ) -> dict:
     import tempfile
 
@@ -439,9 +462,17 @@ def run_arm_traced(
     flight_dir = Path(aux.name) / "flight"
     trace_dir.mkdir()
     flight_dir.mkdir()
+    # The mode rides in the config field (ISSUE 14): sig arms keep the
+    # historic keys so bench_compare gates them against earlier runs;
+    # mac arms (authenticator + tentative execution) are their own
+    # groups on the faulted-path A/B.
+    base_key = (
+        f"chaos {arm}" if arm != "fault-free" else f"scale f={(n - 1) // 3}"
+    )
     row = {
-        "config": f"chaos {arm}" if arm != "fault-free" else f"scale f={(n - 1) // 3}",
+        "config": base_key if mode == "sig" else f"{base_key} {mode}",
         "arm": arm,
+        "mode": mode,
         "replicas": n,
         "f": (n - 1) // 3,
         "clients": clients,
@@ -458,6 +489,8 @@ def run_arm_traced(
             batch_flush_us=batch_flush_us,
             admission_inflight=admission_inflight,
             admission_backlog=admission_backlog,
+            fastpath=mode,
+            tentative=(mode == "mac"),
             faults=faults,
             chaos_drop_pct=drop,
             chaos_seed=seed if drop > 0 else None,
@@ -477,6 +510,9 @@ def run_arm_traced(
                         )
                     )
                 quorum = cluster.config.f + 1
+                tentative_quorum = (
+                    2 * cluster.config.f + 1 if mode == "mac" else 0
+                )
                 ports = [p for _, p in gws]
                 # Warmup (outside the timed region): every tier process
                 # gets live upstream links. Under a mute primary the
@@ -485,6 +521,7 @@ def run_arm_traced(
                     run_load(
                         "127.0.0.1", ports, len(ports), 1, 1, quorum,
                         120.0, token_prefix=f"warm{seed}",
+                        tentative_quorum=tentative_quorum,
                     )
                 )
                 sched = FaultSchedule(cluster, arm, fault_at_s, heal_at_s, gws)
@@ -495,6 +532,7 @@ def run_arm_traced(
                     run_load(
                         "127.0.0.1", ports, clients, requests_each, window,
                         quorum, deadline_s, token_prefix=f"cb{seed}",
+                        tentative_quorum=tentative_quorum,
                         stats=stats,
                     )
                 )
@@ -635,21 +673,28 @@ def main() -> int:
                         help="chaos seed: link-drop pattern + load tokens")
     parser.add_argument("--blackbox-dir", default=None,
                         help="failing arms copy every flight dump here")
+    parser.add_argument(
+        "--mode", default="sig",
+        help="comma-separated fast-path modes per arm (ISSUE 14): sig "
+        "and/or mac (MAC-vector authenticators + tentative execution; "
+        "the driver counts the 2f+1 tentative reply quorum)")
     parser.add_argument("--out", default=None, help="append JSONL here")
     args = parser.parse_args()
 
     arms = [a.strip() for a in args.arms.split(",") if a.strip()]
+    modes = [m.strip() for m in args.mode.split(",") if m.strip()]
     rows = []
     for arm in arms:
-        row = run_arm_traced(
-            arm, args.n, args.clients, args.requests, args.window,
-            args.batch, args.batch_flush_us, args.impl, args.gateways,
-            args.vc_timeout_ms, args.admission_inflight,
-            args.admission_backlog, args.fault_at_s, args.heal_at_s,
-            args.deadline_s, args.seed, args.blackbox_dir,
-        )
-        print(json.dumps(row), flush=True)
-        rows.append(row)
+        for mode in modes:
+            row = run_arm_traced(
+                arm, args.n, args.clients, args.requests, args.window,
+                args.batch, args.batch_flush_us, args.impl, args.gateways,
+                args.vc_timeout_ms, args.admission_inflight,
+                args.admission_backlog, args.fault_at_s, args.heal_at_s,
+                args.deadline_s, args.seed, args.blackbox_dir, mode=mode,
+            )
+            print(json.dumps(row), flush=True)
+            rows.append(row)
     if args.out:
         with open(args.out, "a") as fh:
             for row in rows:

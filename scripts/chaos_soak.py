@@ -113,10 +113,26 @@ def run_one(
     recovery_steps: int = 400,
     verbose: bool = False,
     flight_dir: Optional[str] = None,
+    mode: str = "sig",
 ) -> dict:
-    """One soak run. Returns {ok, seed, n, violation?, schedule, ...}."""
-    cluster = Cluster(n=n, seed=seed, shuffle=True, verifier=_pick_verifier(),
-                      app=_echo_app)
+    """One soak run. Returns {ok, seed, n, violation?, schedule, ...}.
+
+    ``mode`` (ISSUE 14): "mac" soaks the fast path — per-link
+    authenticator acceptance (receive_authenticated, the simulator's
+    model of the MAC lanes) PLUS tentative execution with rollback, so
+    the S1-S3/L1 matrix covers the authenticator+tentative protocol. A
+    deterministic mid-run view change (below) guarantees every seed
+    exercises a view change while tentative executions are in flight —
+    the rollback path is load-bearing, not incidental."""
+    import dataclasses as _dc
+
+    from pbft_tpu.consensus.config import make_local_cluster
+
+    config, seeds = make_local_cluster(n)
+    if mode == "mac":
+        config = _dc.replace(config, fastpath="mac", tentative=True)
+    cluster = Cluster(config=config, seeds=seeds, seed=seed, shuffle=True,
+                      verifier=_pick_verifier(), app=_echo_app, mode=mode)
     recorders = _wire_flight(cluster) if flight_dir else {}
     checker = InvariantChecker(cluster)
     if schedule is None:
@@ -239,6 +255,20 @@ def run_one(
                 print(f"    step {t}: {ev.action} {list(ev.args)}")
         if t % submit_every == 0:
             submit_next()
+        if mode == "mac" and t == max(2, steps // 3):
+            # Mid-tentative view change (ISSUE 14): fire the timers while
+            # requests are in flight so every seed exercises the §5.3
+            # rollback (executions above the committed floor must revert
+            # and re-run under the new view's O).
+            target = 1 + max(
+                (r.pending_view if r.in_view_change else r.view)
+                for r in cluster.replicas
+                if r.id not in cluster.crashed
+            )
+            if verbose:
+                print(f"    step {t}: mid-tentative view change toward "
+                      f"view {target}")
+            cluster.trigger_view_change(new_view=target)
         fail = tick(t, in_recovery=False)
         if fail is not None:
             return with_black_box(fail)
@@ -330,6 +360,11 @@ def main(argv=None) -> int:
                         help="scheduler rounds under the fault schedule")
     parser.add_argument("--n", type=str, default="4,7",
                         help="comma-separated cluster sizes (default 4,7)")
+    parser.add_argument("--mode", type=str, default="sig,mac",
+                        help="comma-separated fast-path modes (ISSUE 14): "
+                        "sig = signature-verified hot path, mac = "
+                        "authenticator acceptance + tentative execution "
+                        "with a forced mid-run view change (default both)")
     parser.add_argument("--replay", type=int, default=None,
                         help="re-run ONE seed verbosely (deterministic)")
     parser.add_argument("--validate", action="store_true",
@@ -342,6 +377,7 @@ def main(argv=None) -> int:
         "string disables.")
     args = parser.parse_args(argv)
     sizes = [int(s) for s in args.n.split(",") if s]
+    modes = [m.strip() for m in args.mode.split(",") if m.strip()]
 
     if args.validate:
         res = validate_checker(verbose=True)
@@ -355,43 +391,49 @@ def main(argv=None) -> int:
 
     if args.replay is not None:
         rc = 0
-        for n in sizes:
-            print(f"replaying seed {args.replay} n={n} steps={args.steps}:")
-            res = run_one(args.replay, n, args.steps,
-                          submit_every=args.submit_every, verbose=True,
-                          flight_dir=args.flight_dir or None)
-            if res["ok"]:
-                print(f"  OK: {res['submitted']} requests, "
-                      f"executed up to {res['executed']}, "
-                      f"{res['faults_injected']} faults injected, "
-                      f"{res['chaos_dropped']} chaos drops")
-            else:
-                res["steps"] = args.steps
-                _print_failure(res)
-                rc = 1
+        for mode in modes:
+            for n in sizes:
+                print(f"replaying seed {args.replay} n={n} mode={mode} "
+                      f"steps={args.steps}:")
+                res = run_one(args.replay, n, args.steps,
+                              submit_every=args.submit_every, verbose=True,
+                              flight_dir=args.flight_dir or None, mode=mode)
+                if res["ok"]:
+                    print(f"  OK: {res['submitted']} requests, "
+                          f"executed up to {res['executed']}, "
+                          f"{res['faults_injected']} faults injected, "
+                          f"{res['chaos_dropped']} chaos drops")
+                else:
+                    res["steps"] = args.steps
+                    _print_failure(res)
+                    rc = 1
         return rc
 
     failures: List[dict] = []
     for i in range(args.seeds):
         seed = args.seed_base + i
-        for n in sizes:
-            res = run_one(seed, n, args.steps, submit_every=args.submit_every,
-                          flight_dir=args.flight_dir or None)
-            if res["ok"]:
-                print(f"seed {seed:>3} n={n}: OK  "
-                      f"({res['submitted']} reqs, exec<={res['executed']}, "
-                      f"{res['faults_injected']} faults, "
-                      f"{res['chaos_dropped']} drops)")
-            else:
-                res["steps"] = args.steps
-                _print_failure(res)
-                failures.append(res)
+        for mode in modes:
+            for n in sizes:
+                res = run_one(seed, n, args.steps,
+                              submit_every=args.submit_every,
+                              flight_dir=args.flight_dir or None, mode=mode)
+                if res["ok"]:
+                    print(f"seed {seed:>3} n={n} mode={mode}: OK  "
+                          f"({res['submitted']} reqs, "
+                          f"exec<={res['executed']}, "
+                          f"{res['faults_injected']} faults, "
+                          f"{res['chaos_dropped']} drops)")
+                else:
+                    res["steps"] = args.steps
+                    res["mode"] = mode
+                    _print_failure(res)
+                    failures.append(res)
     if failures:
         print(f"\n{len(failures)} failing runs; replay any with "
-              "--replay SEED --n N --steps STEPS")
+              "--replay SEED --n N --steps STEPS --mode MODE")
         return 1
-    print(f"\nall {args.seeds} seeds x sizes {sizes} passed every "
-          "safety/liveness invariant")
+    print(f"\nall {args.seeds} seeds x sizes {sizes} x modes {modes} passed "
+          "every safety/liveness invariant")
     return 0
 
 

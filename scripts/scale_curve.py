@@ -99,6 +99,7 @@ async def drive_identity(
     retransmit_s: float,
     deadline_s: float,
     latencies_ms: list,
+    tentative_quorum: int = 0,
 ) -> int:
     """One client identity: pipeline ``window`` requests over its gateway
     connection, count each request complete at ``quorum`` distinct-replica
@@ -153,11 +154,27 @@ async def drive_identity(
                     st = pending.get(ts)
                     if st is None or not isinstance(rid, int):
                         continue
-                    st["votes"][rid] = (obj.get("result"), obj.get("view"))
+                    st["votes"][rid] = (
+                        obj.get("result"),
+                        obj.get("view"),
+                        1 if obj.get("tentative") else 0,
+                    )
+                    # Committed replies complete at `quorum` (f+1)
+                    # matching; tentative ones (ISSUE 14 fast path) need
+                    # `tentative_quorum` (2f+1) matching in one view.
                     by_result: dict = {}
-                    for key in st["votes"].values():
-                        by_result[key] = by_result.get(key, 0) + 1
-                    if max(by_result.values()) >= quorum:
+                    committed: dict = {}
+                    for result, view, tent in st["votes"].values():
+                        by_result[(result, view)] = (
+                            by_result.get((result, view), 0) + 1
+                        )
+                        if not tent:
+                            committed[result] = committed.get(result, 0) + 1
+                    ok = (committed and max(committed.values()) >= quorum) or (
+                        tentative_quorum > 0
+                        and max(by_result.values()) >= tentative_quorum
+                    )
+                    if ok:
                         latencies_ms.append(
                             (time.monotonic() - st["send"]) * 1e3
                         )
@@ -182,6 +199,7 @@ async def run_load(
     quorum: int,
     deadline_s: float,
     token_prefix: str = "lg",
+    tentative_quorum: int = 0,
 ) -> tuple:
     """``clients`` identities split round-robin across the gateway
     ``ports`` (one per gateway process)."""
@@ -191,7 +209,7 @@ async def run_load(
             host, ports[i % len(ports)],
             f"{GATEWAY_CLIENT_PREFIX}{token_prefix}-{i}", requests_each,
             window, quorum, retransmit_s=3.0, deadline_s=deadline_s,
-            latencies_ms=latencies_ms,
+            latencies_ms=latencies_ms, tentative_quorum=tentative_quorum,
         )
         for i in range(clients)
     ]
@@ -215,9 +233,15 @@ def run_point(
     gateways: int,
     deadline_s: float,
     net_threads: int = 1,
+    mode: str = "sig",
 ) -> dict:
     """One sustained point on the curve: an n-replica cluster, a gateway
-    tier in front, ``clients`` concurrent identities through it."""
+    tier in front, ``clients`` concurrent identities through it.
+
+    ``mode`` (ISSUE 14): "mac" runs the fast path — per-link MAC-vector
+    authenticators on normal-case frames AND tentative execution (reply
+    at PREPARED; the driver then counts the 2f+1 tentative quorum) —
+    the A/B axis against the unchanged signature-mode arm."""
     # THIS process (the load generator) holds one socket per identity
     # plus slack; each gateway is its own process with its own limit
     # (inheriting the raised soft limit) holding clients/gateways
@@ -231,6 +255,8 @@ def run_point(
         batch_max_items=batch,
         batch_flush_us=batch_flush_us,
         net_threads=net_threads,
+        fastpath=mode,
+        tentative=(mode == "mac"),
     ) as cluster:
         cfg_path = Path(cluster.tmpdir.name) / "network.json"
         gws = []
@@ -243,18 +269,23 @@ def run_point(
                     )
                 )
             quorum = cluster.config.f + 1
+            tentative_quorum = (
+                2 * cluster.config.f + 1 if mode == "mac" else 0
+            )
             ports = [gport for _, gport in gws]
             # One warmup request per gateway (so every tier process has
             # live upstream links) before the timed region.
             asyncio.run(
                 run_load("127.0.0.1", ports, len(ports), 1, 1, quorum,
-                         120.0, token_prefix="warm")
+                         120.0, token_prefix="warm",
+                         tentative_quorum=tentative_quorum)
             )
             t0 = time.perf_counter()
             done, elapsed, lat = asyncio.run(
                 run_load(
                     "127.0.0.1", ports, clients, requests_each, window,
                     quorum, deadline_s,
+                    tentative_quorum=tentative_quorum,
                 )
             )
             elapsed = time.perf_counter() - t0
@@ -287,11 +318,18 @@ def run_point(
     # net-threads=1 arm keeps the historic key so bench_compare
     # --group-by config gates it against scale_curve_r10; each
     # net-threads>1 arm becomes its own group on the per-core curve.
+    # The mode rides in the config field (ISSUE 14): the sig arm keeps
+    # the historic key so bench_compare --group-by config gates it
+    # against multicore_r13/scale_curve_r10; mac arms are their own
+    # groups on the A/B curve.
     config_key = f"scale f={(n - 1) // 3}"
     if net_threads > 1:
         config_key += f" t{net_threads}"
+    if mode != "sig":
+        config_key += f" {mode}"
     return {
         "config": config_key,
+        "mode": mode,
         "replicas": n,
         "f": (n - 1) // 3,
         "clients": clients,
@@ -343,21 +381,31 @@ def main() -> int:
         "rides into the JSONL config field so bench_compare --group-by "
         "config gates the per-core curve",
     )
+    parser.add_argument(
+        "--mode", default="sig",
+        help="comma-separated fast-path modes per point (ISSUE 14): sig "
+        "(the unchanged signature path) and/or mac (MAC-vector "
+        "authenticators + tentative execution; the driver counts the "
+        "2f+1 tentative reply quorum). Rides into the JSONL config "
+        "field for bench_compare --group-by.",
+    )
     parser.add_argument("--deadline-s", type=float, default=600.0,
                         help="hard per-point wall-clock bound")
     parser.add_argument("--out", default=None, help="append JSONL here")
     args = parser.parse_args()
 
     ns = [int(x) for x in args.n.split(",") if x.strip()]
+    modes = [m.strip() for m in args.mode.split(",") if m.strip()]
     rows = []
     for n in ns:
-        row = run_point(
-            n, args.clients, args.requests, args.window, args.batch,
-            args.batch_flush_us, args.impl, args.gateways, args.deadline_s,
-            net_threads=args.net_threads,
-        )
-        print(json.dumps(row), flush=True)
-        rows.append(row)
+        for mode in modes:
+            row = run_point(
+                n, args.clients, args.requests, args.window, args.batch,
+                args.batch_flush_us, args.impl, args.gateways,
+                args.deadline_s, net_threads=args.net_threads, mode=mode,
+            )
+            print(json.dumps(row), flush=True)
+            rows.append(row)
     if args.out:
         with open(args.out, "a") as fh:
             for row in rows:

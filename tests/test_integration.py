@@ -823,3 +823,99 @@ def test_mute_primary_bounded_view_change_storm(tmp_path):
                 assert views and int(views[-1]) >= 1
         finally:
             client.close()
+
+
+# -- fast-path modes (ISSUE 14, protocol 1.3.0) -------------------------------
+
+
+def _last_mode_metrics(cluster, rid: int) -> dict:
+    import json
+    from pathlib import Path
+
+    log = (Path(cluster.tmpdir.name) / f"replica-{rid}.log").read_text(
+        errors="ignore"
+    )
+    lines = [ln for ln in log.splitlines() if '"mode"' in ln]
+    assert lines, f"replica {rid} printed no metrics lines:\n{log[-2000:]}"
+    return json.loads(lines[-1][lines[-1].index("{"):])
+
+
+def test_fastpath_mac_tentative_mixed_cluster_commits():
+    """A mixed cxx/py cluster in authenticator + tentative mode: requests
+    commit through MAC-vector frames (zero hot-path signature verifies
+    beyond the negotiation window), replies leave at PREPARED, and the
+    committed floor catches up to execution."""
+    import time
+
+    with LocalCluster(
+        n=4,
+        verifier="cpu",
+        metrics_every=1,
+        impl=["cxx", "py", "cxx", "py"],
+        fastpath="mac",
+        tentative=True,
+    ) as cluster:
+        client = PbftClient(cluster.config)
+        try:
+            for k in range(6):
+                r = client.request(f"fp-{k}")
+                assert client.wait_result(r.timestamp, timeout=30) == "awesome!"
+        finally:
+            client.close()
+        time.sleep(1.6)  # one more metrics tick
+        for i in range(4):
+            m = _last_mode_metrics(cluster, i)
+            assert m["mode"] == "mac", (i, m)
+            assert m["tentative"] is True or m["tentative"] == 1, (i, m)
+            assert m["mac_frames"] > 0, (i, m)
+            assert m["mac_verified"] > 0, (i, m)
+            assert m["mac_rejected"] == 0, (i, m)
+            assert m["tentative_executions"] > 0, (i, m)
+            assert m["committed_upto"] == m["executed_upto"] == 6, (i, m)
+
+
+@pytest.mark.parametrize(
+    "impl",
+    [["cxx", "py", "cxx", "py"], ["py", "cxx", "py", "cxx"]],
+    ids=["cxx-primary", "py-primary"],
+)
+def test_fastpath_mixed_version_negotiates_down(impl):
+    """A 1.3.0 mac cluster with two peers capped to the 1.2.0 hello
+    (PBFT_PROTO_CAP, the pre-1.3.0 stand-in): every link to a capped
+    peer falls back to signature mode byte-for-byte, the capped peers
+    never send or accept a MAC frame, and the cluster still commits."""
+    import time
+
+    cap = {"PBFT_PROTO_CAP": "1.2.0"}
+    with LocalCluster(
+        n=4,
+        verifier="cpu",
+        metrics_every=1,
+        impl=impl,
+        extra_env=[None, None, cap, cap],
+        fastpath="mac",
+        tentative=False,
+    ) as cluster:
+        client = PbftClient(cluster.config)
+        try:
+            for k in range(6):
+                r = client.request(f"mix-{k}")
+                assert client.wait_result(r.timestamp, timeout=30) == "awesome!"
+        finally:
+            client.close()
+        time.sleep(1.6)
+        m0 = _last_mode_metrics(cluster, 0)
+        m1 = _last_mode_metrics(cluster, 1)
+        # The 1.3.0 pair still uses MAC frames on their mutual link...
+        assert m0["mode"] == "mac" and m0["mac_frames"] > 0, m0
+        assert m1["mode"] == "mac" and m1["mac_frames"] > 0, m1
+        for i in (2, 3):
+            m = _last_mode_metrics(cluster, i)
+            # ...while the capped peers advertise 1.2.0 and never touch
+            # the fast path in either direction.
+            assert m["mode"] == "sig", (i, m)
+            assert m["mac_frames"] == 0 and m["mac_verified"] == 0, (i, m)
+        # Every replica executed everything: the sig fallback carried the
+        # capped links.
+        for i in range(4):
+            assert _last_mode_metrics(cluster, i)["executed_upto"] == 6

@@ -95,11 +95,64 @@ def test_divergent_constant_trips(tmp_path):
 def test_divergent_protocol_version_trips(tmp_path):
     root = _shadow_tree(tmp_path)
     sec = root / "pbft_tpu" / "net" / "secure.py"
-    sec.write_text(sec.read_text().replace(
-        'PROTOCOL_VERSION = "pbft-tpu/1.2.0"',
-        'PROTOCOL_VERSION = "pbft-tpu/1.3.0"'))
+    text = sec.read_text()
+    assert 'PROTOCOL_VERSION = "pbft-tpu/1.3.0"' in text
+    sec.write_text(text.replace(
+        'PROTOCOL_VERSION = "pbft-tpu/1.3.0"',
+        'PROTOCOL_VERSION = "pbft-tpu/1.4.0"'))
     errors = constants.check(root)
     assert any("protocol version (current)" in e for e in errors), errors
+
+
+def test_divergent_mac_constants_trip(tmp_path):
+    """ISSUE 14 pairs: a drifted MAC tag length, domain label, or frame
+    code each fails the build — one byte of drift and a mixed-runtime
+    mac link rejects every frame."""
+    root = _shadow_tree(tmp_path)
+    sec = root / "pbft_tpu" / "net" / "secure.py"
+    text = sec.read_text()
+    assert "MAC_TAG_LEN = 16" in text
+    sec.write_text(text.replace("MAC_TAG_LEN = 16", "MAC_TAG_LEN = 12"))
+    errors = constants.check(root)
+    assert any("MAC tag length" in e for e in errors), errors
+
+    root2 = _shadow_tree(tmp_path / "b")
+    sec2 = root2 / "pbft_tpu" / "net" / "secure.py"
+    sec2.write_text(sec2.read_text().replace(
+        'MAC_CONTEXT = "pbft-tpu-auth1|"', 'MAC_CONTEXT = "pbft-tpu-auth2|"'))
+    errors = constants.check(root2)
+    assert any("MAC domain-separation label" in e for e in errors), errors
+
+    root3 = _shadow_tree(tmp_path / "c")
+    msgs = root3 / "pbft_tpu" / "consensus" / "messages.py"
+    msgs.write_text(msgs.read_text().replace(
+        "_BIN_PREPARE_MAC = 0x13", "_BIN_PREPARE_MAC = 0x17"))
+    errors = constants.check(root3)
+    assert any("binary tag: prepare (MAC)" in e for e in errors), errors
+
+
+def test_divergent_tentative_field_trips(tmp_path):
+    """The tentative-reply member name is SIGNED content: a renamed
+    field forks every tentative reply's signable bytes across runtimes."""
+    root = _shadow_tree(tmp_path)
+    msgs = root / "pbft_tpu" / "consensus" / "messages.py"
+    text = msgs.read_text()
+    assert 'TENTATIVE_FIELD = "tentative"' in text
+    msgs.write_text(text.replace(
+        'TENTATIVE_FIELD = "tentative"', 'TENTATIVE_FIELD = "tent"'))
+    errors = constants.check(root)
+    assert any("tentative-reply field tag" in e for e in errors), errors
+
+
+def test_divergent_fastpath_default_trips(tmp_path):
+    root = _shadow_tree(tmp_path)
+    cfg = root / "pbft_tpu" / "consensus" / "config.py"
+    text = cfg.read_text()
+    assert 'fastpath: str = "sig"' in text
+    cfg.write_text(text.replace(
+        'fastpath: str = "sig"', 'fastpath: str = "mac"'))
+    errors = constants.check(root)
+    assert any("ClusterConfig default: fastpath" in e for e in errors), errors
 
 
 def test_divergent_config_default_trips(tmp_path):

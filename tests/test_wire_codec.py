@@ -256,6 +256,75 @@ def test_binary_malformed_rejected_by_native():
         assert native.message_from_binary(bad) is None
 
 
+# -- MAC-vector frame variants (ISSUE 14) -------------------------------------
+
+
+def _rand_lanes(rng):
+    count = rng.randrange(1, 9)
+    rids = rng.sample(range(64), count)
+    return [
+        (rid, bytes(rng.getrandbits(8) for _ in range(16)))
+        for rid in sorted(rids)
+    ]
+
+
+def test_mac_frame_roundtrip_python_fuzz():
+    rng = _rng()
+    for _ in range(40):
+        for msg in _rand_hot(rng):
+            if isinstance(msg, M.ClientRequest):
+                continue  # no sig field, no MAC form
+            lanes = _rand_lanes(rng)
+            frame = M.to_binary_mac(msg, lanes)
+            assert frame is not None, type(msg).__name__
+            assert frame[0] == M.WIRE_BINARY_MAGIC
+            assert M.payload_is_mac_frame(frame)
+            assert M.from_binary(frame) == msg
+            assert M.decode_payload(frame) == msg
+            for rid, tag in lanes:
+                assert M.mac_frame_lane(frame, rid) == tag
+            absent = next(r for r in range(70) if r not in dict(lanes))
+            assert M.mac_frame_lane(frame, absent) is None
+
+
+@pytest.mark.skipif(not HAVE_NATIVE, reason="native core not buildable")
+def test_mac_frame_cross_runtime_byte_parity_fuzz():
+    """C++ and Python MAC-vector frames must be byte-identical for
+    randomized messages + lane sets, the C++ decode must recover the
+    identical canonical JSON/signable, and lane extraction must agree."""
+    rng = _rng()
+    for _ in range(30):
+        for msg in _rand_hot(rng):
+            if isinstance(msg, M.ClientRequest):
+                continue
+            lanes = _rand_lanes(rng)
+            pyb = M.to_binary_mac(msg, lanes)
+            cxxb = native.message_to_binary_mac(msg.canonical(), lanes)
+            assert cxxb == pyb, type(msg).__name__
+            decoded = native.message_from_binary(pyb)
+            assert decoded is not None
+            canon, digest = decoded
+            assert canon == msg.canonical()
+            assert digest == msg.signable()
+            for rid, tag in lanes:
+                assert native.mac_frame_lane(pyb, rid) == tag
+            absent = next(r for r in range(70) if r not in dict(lanes))
+            assert native.mac_frame_lane(pyb, absent) is None
+
+
+@pytest.mark.skipif(not HAVE_NATIVE, reason="native core not buildable")
+def test_mac_frame_malformed_rejected_by_native():
+    msg = M.Prepare(view=0, seq=1, digest="ab" * 32, replica=0, sig="cd" * 64)
+    frame = M.to_binary_mac(msg, [(1, bytes(16)), (2, b"\x11" * 16)])
+    assert native.message_from_binary(frame) is not None
+    for bad in (
+        frame[:-2],                    # truncated vector
+        frame[:-1] + bytes([77]),      # count past the bound
+        frame[:-1] + bytes([0]),       # zero-lane vector
+    ):
+        assert native.message_from_binary(bad) is None
+
+
 # -- receive-side signable reuse ---------------------------------------------
 
 
