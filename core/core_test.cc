@@ -6,6 +6,7 @@
 #include <netinet/in.h>
 #include <poll.h>
 #include <sys/socket.h>
+#include <sys/stat.h>
 #include <unistd.h>
 
 #include <chrono>
@@ -1078,6 +1079,139 @@ void test_flight_recorder() {
   CHECK(fl.total_recorded() == 6);
 }
 
+void test_wal_roundtrip() {
+  // Durable recovery (ISSUE 15). The golden bytes here are ALSO pinned
+  // by tests/test_wal.py test_record_golden_bytes against the Python
+  // encoder — the two on-disk formats cannot drift without one pin
+  // going red.
+  const std::string dir =
+      "/tmp/pbft-core-test-wal-" + std::to_string((long)::getpid());
+  ::mkdir(dir.c_str(), 0755);
+  const std::string path = dir + "/replica-0.wal";
+  std::remove(path.c_str());
+  {
+    pbft::Wal wal;
+    CHECK(wal.open(path, /*do_fsync=*/false));
+    wal.note_view(3, true, 4);
+    // The same "ab"*32 digest the Python golden test writes.
+    std::string ab;
+    for (int i = 0; i < 32; ++i) ab += "ab";
+    CHECK(wal.note_vote(pbft::kWalVotePrepare, 3, 17, ab));
+    CHECK(wal.note_vote(pbft::kWalVotePrepare, 3, 17, ab));  // idempotent
+    CHECK(!wal.note_vote(pbft::kWalVotePrepare, 3, 17,
+                         std::string(64, 'c')));  // contradiction refused
+    wal.note_checkpoint(16, "PAYLOAD", "[]");
+    wal.flush();  // checkpoint -> compaction: canonical file image
+  }
+  std::string data;
+  {
+    FILE* f = std::fopen(path.c_str(), "rb");
+    CHECK(f != nullptr);
+    char buf[4096];
+    size_t n;
+    while ((n = std::fread(buf, 1, sizeof(buf), f)) > 0) data.append(buf, n);
+    std::fclose(f);
+  }
+  // Golden image: header + view + checkpoint + the surviving vote.
+  CHECK(data.size() == 12 + 22 + (5 + 8 + 4 + 7 + 4 + 2) + 54);
+  CHECK(std::memcmp(data.data(), "PBFTWAL1", 8) == 0);
+  CHECK((uint8_t)data[8] == 1);                     // version (LE)
+  CHECK((uint8_t)data[12] == pbft::kWalRecView);    // tag
+  CHECK((uint8_t)data[17] == 3);                    // view (LE i64)
+  CHECK((uint8_t)data[25] == 1);                    // in_view_change
+  CHECK((uint8_t)data[26] == 4);                    // pending view
+  size_t off = 12 + 22;
+  CHECK((uint8_t)data[off] == pbft::kWalRecCheckpoint);
+  CHECK((uint8_t)data[off + 5] == 16);              // seq
+  CHECK(data.substr(off + 17, 7) == "PAYLOAD");
+  CHECK(data.substr(off + 28, 2) == "[]");
+  off += 5 + 8 + 4 + 7 + 4 + 2;
+  CHECK((uint8_t)data[off] == pbft::kWalRecVote);
+  CHECK((uint8_t)data[off + 5] == pbft::kWalVotePrepare);
+  CHECK((uint8_t)data[off + 14] == 17);             // seq
+  CHECK((uint8_t)data[off + 22] == 0xAB);           // raw digest byte
+  // Replay: guards re-arm, checkpoint + vote recovered, torn tail
+  // (partial record appended by a mid-write kill) tolerated.
+  {
+    pbft::WalState st;
+    CHECK(pbft::wal_decode(data, &st));
+    CHECK(st.view == 3 && st.in_view_change && st.pending_view == 4);
+    CHECK(st.has_checkpoint && st.checkpoint_seq == 16);
+    CHECK(st.checkpoint_payload == "PAYLOAD");
+    CHECK(st.votes.size() == 1);
+    std::string torn = data;
+    torn.push_back((char)pbft::kWalRecVote);
+    torn.append("\x31\x00\x00\x00xx", 6);  // claims 49 bytes, has 2
+    pbft::WalState st2;
+    CHECK(pbft::wal_decode(torn, &st2));
+    CHECK(st2.votes.size() == 1);
+    pbft::WalState bad;
+    CHECK(!pbft::wal_decode(std::string("NOTAWAL0") + std::string(8, '\0'),
+                            &bad));
+  }
+  {
+    pbft::Wal wal2;
+    CHECK(wal2.open(path, false));
+    CHECK(!wal2.recovered().empty());
+    CHECK(!wal2.note_vote(pbft::kWalVotePrepare, 3, 17,
+                          std::string(64, 'c')));
+  }
+  std::remove(path.c_str());
+  ::rmdir(dir.c_str());
+  // End to end: a wal-backed MiniCluster persists votes + checkpoints
+  // through real rounds, and a restarted twin of replica 3 reinstalls
+  // the stable checkpoint, re-joins the same view, and refuses to
+  // contradict any persisted vote.
+  {
+    std::vector<std::vector<uint8_t>> seeds;
+    auto cfg = test_config(&seeds);
+    cfg.checkpoint_interval = 4;
+    const std::string dir2 =
+        "/tmp/pbft-core-test-wal2-" + std::to_string((long)::getpid());
+    ::mkdir(dir2.c_str(), 0755);
+    MiniCluster c(cfg, seeds);
+    std::vector<std::unique_ptr<pbft::Wal>> wals;
+    for (int i = 0; i < 4; ++i) {
+      wals.push_back(std::make_unique<pbft::Wal>());
+      CHECK(wals[i]->open(
+          dir2 + "/replica-" + std::to_string(i) + ".wal", false));
+      c.replicas[i].set_wal(wals[i].get());
+    }
+    for (int t = 1; t <= 6; ++t) {
+      pbft::ClientRequest req;
+      req.operation = "op-" + std::to_string(t);
+      req.timestamp = t;
+      req.client = "127.0.0.1:9000";
+      c.emit(0, c.replicas[0].on_client_request(req));
+      c.run();
+      for (auto& w : wals) w->flush();  // the runtimes' emit-boundary
+    }
+    CHECK(c.replicas[3].executed_upto() == 6);
+    CHECK(c.replicas[3].low_mark() == 4);  // stable checkpoint persisted
+    const std::string chain3 = c.replicas[3].state_digest_hex();
+    // "Crash" replica 3: reopen its log cold and restore a fresh twin.
+    const std::string wpath = dir2 + "/replica-3.wal";
+    pbft::Wal wal3;
+    CHECK(wal3.open(wpath, false));
+    CHECK(wal3.recovered().has_checkpoint);
+    CHECK(wal3.recovered().checkpoint_seq == 4);
+    CHECK(!wal3.recovered().votes.empty());  // seqs 5-6 survive the prune
+    pbft::Replica twin(cfg, 3, seeds[3].data());
+    twin.set_wal(&wal3);
+    CHECK(twin.restore_from_wal(wal3.recovered()));
+    CHECK(twin.executed_upto() == 4);  // the checkpoint floor
+    CHECK(twin.low_mark() == 4);
+    CHECK(twin.view() == 0);  // the SAME view
+    CHECK(twin.state_digest_hex() != chain3);  // floor, not head...
+    CHECK(twin.state_digest_hex() !=
+          std::string(64, '0'));  // ...but a real restored chain
+    for (int i = 0; i < 4; ++i) {
+      std::remove((dir2 + "/replica-" + std::to_string(i) + ".wal").c_str());
+    }
+    ::rmdir(dir2.c_str());
+  }
+}
+
 int main() {
   test_sha512_vectors();
   test_blake2b_vector();
@@ -1098,6 +1232,7 @@ int main() {
   test_mac_codec_native();
   test_fastpath_mac_parity();
   test_flight_recorder();
+  test_wal_roundtrip();
   if (g_failures) {
     std::fprintf(stderr, "%d failure(s)\n", g_failures);
     return 1;

@@ -45,6 +45,12 @@ enum FlightEvent : uint16_t {
   // certified-checkpoint catch-up (seq = sequences rolled back).
   kFlightTentativeReply = 15,
   kFlightTentativeRollback = 16,
+  // Durable recovery coverage (ISSUE 15): WAL replay began (view = the
+  // persisted view, seq = the stable-checkpoint floor) and recovery
+  // finished (seq = the recovered executed_upto) — the restart span the
+  // chaos bench reports as pbft_recovery_seconds.
+  kFlightRecoveryStarted = 17,
+  kFlightRecoveryComplete = 18,
 };
 
 struct FlightRecord {

@@ -52,6 +52,10 @@ const char* kCounterNames[] = {
     // sent, sequences executed at PREPARED, tentative rollbacks.
     "pbft_mac_frames_total", "pbft_tentative_executions_total",
     "pbft_tentative_rollbacks_total",
+    // Durable-recovery surface (ISSUE 15): WAL records appended, group-
+    // commit fsync syscalls, and file bytes written.
+    "pbft_wal_appends_total", "pbft_wal_fsyncs_total",
+    "pbft_wal_bytes_total",
 };
 const char* kGaugeNames[] = {
     "pbft_verify_queue_depth",
@@ -75,6 +79,9 @@ const char* kGaugeNames[] = {
     // the crypto-pipeline offload queues.
     "pbft_net_loop_threads",
     "pbft_crypto_offload_queue_depth",
+    // Durable-recovery surface (ISSUE 15): wall seconds the last WAL
+    // replay + state reinstall took (0 = no recovery this life).
+    "pbft_recovery_seconds",
 };
 // name -> uses the size bucket ladder (else latency).
 const std::pair<const char*, bool> kHistogramNames[] = {

@@ -379,6 +379,15 @@ class ReplicaServer {
     fault_mode_ = b ? FaultMode::kSigCorrupt : FaultMode::kNone;
   }
 
+  // Durable replica recovery (ISSUE 15): open {dir}/replica-{id}.wal
+  // (group-commit fsync per cfg.wal_fsync), replay it, reinstall the
+  // persisted safety state into the replica, and wire the no-
+  // contradiction guards. Call before start(). Returns false when the
+  // log is corrupt/unwritable. recovered_from_wal() reports whether the
+  // replay found pre-crash state to reinstall.
+  bool enable_wal(const std::string& dir);
+  bool recovered_from_wal() const { return recovered_from_wal_; }
+
   // Seeded link-level chaos (ISSUE 5): every outbound peer frame is
   // dropped with probability drop_pct, and (when delay_ms > 0) held for a
   // uniform 0..delay_ms before hitting the socket — per-destination FIFO,
@@ -514,6 +523,18 @@ class ReplicaServer {
   uint8_t seed_[32];  // identity seed: signs secure-link handshakes too
   std::unique_ptr<Verifier> verifier_;
   std::unique_ptr<Replica> replica_;
+  // Write-ahead log (ISSUE 15): flushed at the emit boundary (before any
+  // of a pass's votes reach a socket) and once per poll pass; the
+  // counters below are last-seen snapshots for the metric deltas.
+  std::unique_ptr<Wal> wal_;
+  bool recovered_from_wal_ = false;
+  double recovery_seconds_ = 0.0;
+  int64_t seen_wal_appends_ = 0;
+  int64_t seen_wal_fsyncs_ = 0;
+  int64_t seen_wal_bytes_ = 0;
+  // Group-commit point: write+fsync everything noted since the last
+  // flush, then fold the wal counters into the metrics registry.
+  void flush_wal();
   void trace_batch(int64_t size, int64_t rejected, double secs);
   void trace_view_change(int backoff);
   // Request-level waterfall events (ISSUE 9; schemas in

@@ -66,6 +66,10 @@ int main(int argc, char** argv) {
   // Fast-path overrides (ISSUE 14): "" keeps network.json's values.
   std::string fastpath;
   bool tentative = false;
+  // Durable recovery (ISSUE 15): --wal-dir overrides network.json
+  // wal_dir; --wal-fsync 0|1 overrides wal_fsync (-1 = keep).
+  std::string wal_dir_override;
+  int wal_fsync = -1;
   // Fault injection (ISSUE 5): --fault generalizes --byzantine to the
   // full behavior-mode set; --chaos-* are seeded link-level knobs.
   std::string fault_mode_name;
@@ -89,6 +93,8 @@ int main(int argc, char** argv) {
     else if (a == "--net-threads") net_threads = std::atoll(next());
     else if (a == "--fastpath") fastpath = next();
     else if (a == "--tentative") tentative = true;
+    else if (a == "--wal-dir") wal_dir_override = next();
+    else if (a == "--wal-fsync") wal_fsync = std::atoi(next());
     else if (a == "--discovery") discovery = next();
     else if (a == "--trace") trace_path = next();
     else if (a == "--flight-file") flight_path = next();
@@ -144,6 +150,10 @@ int main(int argc, char** argv) {
   // change (ISSUE 14). network.json stays the default source of truth.
   if (fastpath == "sig" || fastpath == "mac") cfg->fastpath = fastpath;
   if (tentative) cfg->tentative = true;
+  // Durable recovery (ISSUE 15): the WAL lives at
+  // {wal_dir}/replica-{id}.wal; group-commit fsync per wal_fsync.
+  if (!wal_dir_override.empty()) cfg->wal_dir = wal_dir_override;
+  if (wal_fsync >= 0) cfg->wal_fsync = wal_fsync != 0;
   uint8_t seed[32];
   if (!pbft::from_hex(seed_hex, seed, 32)) {
     std::fprintf(stderr, "bad --seed hex\n");
@@ -178,6 +188,16 @@ int main(int argc, char** argv) {
   }
   if (!discovery.empty()) server.enable_discovery(discovery);
   if (!trace_path.empty()) server.set_trace_file(trace_path);
+  if (!flight_path.empty()) {
+    // Configure the ring BEFORE enable_wal so a restart-from-disk ships
+    // its recovery_started/recovery_complete records too.
+    pbft::global_flight().configure(8192);
+  }
+  if (!cfg->wal_dir.empty() && !server.enable_wal(cfg->wal_dir)) {
+    std::fprintf(stderr, "replica %lld: --wal-dir %s unusable\n",
+                 (long long)id, cfg->wal_dir.c_str());
+    return 1;
+  }
   if (!server.start()) {
     std::fprintf(stderr, "replica %lld: bind failed on port %d\n",
                  (long long)id, cfg->replicas[id].port);
@@ -189,8 +209,8 @@ int main(int argc, char** argv) {
   if (!flight_path.empty()) {
     // Black-box flight recorder (ISSUE 9): the last 8192 protocol events
     // in a lock-free ring, dumped on every exit path — clean stop, the
-    // final metrics line's sibling, or a fatal signal mid-crash.
-    pbft::global_flight().configure(8192);
+    // final metrics line's sibling, or a fatal signal mid-crash. The
+    // ring itself was configured before enable_wal (recovery records).
     g_flight_path = flight_path.c_str();
     std::signal(SIGSEGV, on_fatal);
     std::signal(SIGABRT, on_fatal);

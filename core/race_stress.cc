@@ -27,6 +27,7 @@
 #include <netinet/in.h>
 #include <poll.h>
 #include <sys/socket.h>
+#include <sys/stat.h>
 #include <unistd.h>
 
 #include <atomic>
@@ -469,6 +470,106 @@ void stress_chaos_cluster(int scale) {
 // against every replica (SO_REUSEPORT spreads them across shards), and a
 // cross-thread stop() that must tear down every shard and pipeline
 // cleanly. TSan-clean here is the ISSUE 13 acceptance gate.
+// --- 6d. write-ahead log append/flush/replay (ISSUE 15) ---------------------
+//
+// The durability layer's concurrent surface: writer threads noting votes
+// and view transitions into one Wal, a group-commit flusher, a replayer
+// re-reading the file image mid-write (append-only: the only legal
+// anomaly is a torn tail, which wal_decode tolerates), and a pair of
+// contradiction threads racing to claim ONE slot with different digests
+// — exactly one must win, forever. Cross-thread stop ends every leg.
+void stress_wal(int scale) {
+  const std::string dir =
+      "/tmp/pbft-race-stress-wal-" + std::to_string(::getpid());
+  ::mkdir(dir.c_str(), 0755);
+  const std::string path = dir + "/replica-0.wal";
+  ::unlink(path.c_str());
+  pbft::Wal wal;
+  CHECK(wal.open(path, /*do_fsync=*/false));
+  std::atomic<bool> stop{false};
+  const std::string digest_a(64, 'a');
+  const std::string digest_b(64, 'b');
+  std::vector<std::thread> writers;
+  std::atomic<int64_t> noted{0};
+  for (int w = 0; w < 3; ++w) {
+    writers.emplace_back([&, w] {
+      int64_t seq = 0;
+      while (!stop.load(std::memory_order_relaxed)) {
+        // Disjoint (kind, view, seq) per writer: every note must land.
+        CHECK(wal.note_vote(pbft::kWalVotePrepare, w, ++seq, digest_a));
+        CHECK(wal.note_vote(pbft::kWalVoteCommit, w, seq, digest_a));
+        if ((seq & 63) == 0) wal.note_view(w, false, 0);
+        noted.fetch_add(2, std::memory_order_relaxed);
+      }
+    });
+  }
+  // Contradiction racers: one durable claim per slot, ever. Whichever
+  // digest lands first must keep winning; the loser always gets false.
+  std::vector<std::thread> racers;
+  std::atomic<int> wins_a{0}, wins_b{0};
+  for (int r = 0; r < 2; ++r) {
+    racers.emplace_back([&, r] {
+      const std::string& mine = r == 0 ? digest_a : digest_b;
+      std::atomic<int>& wins = r == 0 ? wins_a : wins_b;
+      int64_t slot = 0;
+      while (!stop.load(std::memory_order_relaxed)) {
+        if (wal.note_vote(pbft::kWalVotePrePrepare, 99, ++slot, mine)) {
+          wins.fetch_add(1, std::memory_order_relaxed);
+        }
+        if (slot > 4096) slot = 0;  // revisit: answers must be stable
+      }
+    });
+  }
+  std::thread flusher([&] {
+    while (!stop.load(std::memory_order_relaxed)) {
+      wal.flush();  // group commit: one write per pass, however many notes
+    }
+  });
+  std::thread replayer([&] {
+    while (!stop.load(std::memory_order_relaxed)) {
+      std::string data;
+      if (FILE* f = std::fopen(path.c_str(), "rb")) {
+        char buf[65536];
+        size_t n;
+        while ((n = std::fread(buf, 1, sizeof(buf), f)) > 0)
+          data.append(buf, n);
+        std::fclose(f);
+      }
+      pbft::WalState st;
+      // A mid-append read may tear only the tail; never the header.
+      CHECK(pbft::wal_decode(data, &st));
+    }
+  });
+  std::this_thread::sleep_for(std::chrono::milliseconds(150 * scale));
+  stop.store(true, std::memory_order_relaxed);
+  for (auto& t : writers) t.join();
+  for (auto& t : racers) t.join();
+  flusher.join();
+  replayer.join();
+  wal.flush();
+  CHECK(noted.load() > 0);
+  CHECK(wins_a.load() + wins_b.load() > 0);
+  pbft::WalState st;
+  {
+    std::string data;
+    FILE* f = std::fopen(path.c_str(), "rb");
+    CHECK(f != nullptr);
+    char buf[65536];
+    size_t n;
+    while ((n = std::fread(buf, 1, sizeof(buf), f)) > 0) data.append(buf, n);
+    std::fclose(f);
+    CHECK(pbft::wal_decode(data, &st));
+  }
+  // Every durable claim is exactly one digest; the racers' slots hold
+  // a or b, never both and never a mix within one slot.
+  for (const auto& [key, digest] : st.votes) {
+    CHECK(digest == digest_a || digest == digest_b);
+  }
+  CHECK((int64_t)st.votes.size() > 0);
+  ::unlink(path.c_str());
+  ::rmdir(dir.c_str());
+}
+
 void stress_sharded_loops(int scale) {
   int ports[4];
   int hold[4];
@@ -476,9 +577,17 @@ void stress_sharded_loops(int scale) {
     hold[i] = listen_on_ephemeral(&ports[i]);
     CHECK(hold[i] >= 0);
   }
+  // Durable recovery rides along (ISSUE 15): every replica keeps a WAL
+  // (fsync off for speed) so the group-commit flush runs on the
+  // consensus thread while the shard/pipeline threads churn.
+  const std::string wal_dir =
+      "/tmp/pbft-race-stress-shardwal-" + std::to_string(::getpid());
+  ::mkdir(wal_dir.c_str(), 0755);
   pbft::ClusterConfig cfg;
   cfg.net_threads = 2;
   cfg.secure = true;
+  cfg.wal_dir = wal_dir;
+  cfg.wal_fsync = false;
   std::vector<std::vector<uint8_t>> seeds;
   for (int i = 0; i < 4; ++i) {
     std::vector<uint8_t> seed(32, (uint8_t)(i + 29));
@@ -498,6 +607,7 @@ void stress_sharded_loops(int scale) {
     servers[i]->set_chaos(/*drop_pct=*/0.01, /*delay_ms=*/4,
                           /*seed=*/0xD1CE + (uint64_t)i);
     servers[i]->set_view_change_timeout(400);
+    CHECK(servers[i]->enable_wal(wal_dir));
     CHECK(servers[i]->start());
   }
   std::vector<std::thread> loops;
@@ -591,6 +701,26 @@ void stress_sharded_loops(int scale) {
     if (s->replica().executed_upto() > 0) progressed = true;
   }
   CHECK(progressed);
+  // The WAL of every replica replays cleanly and holds its votes
+  // (ISSUE 15): the group-commit path stayed coherent under the shard
+  // churn and the cross-thread stop.
+  for (int i = 0; i < 4; ++i) {
+    const std::string p = wal_dir + "/replica-" + std::to_string(i) + ".wal";
+    std::string data;
+    if (FILE* f = std::fopen(p.c_str(), "rb")) {
+      char buf[65536];
+      size_t n;
+      while ((n = std::fread(buf, 1, sizeof(buf), f)) > 0) data.append(buf, n);
+      std::fclose(f);
+    }
+    pbft::WalState st;
+    CHECK(pbft::wal_decode(data, &st));
+    if (servers[i]->replica().executed_upto() > 0) {
+      CHECK(st.votes.size() > 0 || st.has_checkpoint);
+    }
+    ::unlink(p.c_str());
+  }
+  ::rmdir(wal_dir.c_str());
   ::close(reply_fd);
 }
 
@@ -990,6 +1120,8 @@ int main(int argc, char** argv) {
   stress_remote_verifier(small, scale);
   std::printf("[race_stress] flight recorder record/dump...\n");
   stress_flight_recorder(scale);
+  std::printf("[race_stress] WAL append/flush/replay (ISSUE 15)...\n");
+  stress_wal(scale);
   std::printf("[race_stress] chaos cluster delay-queue pump...\n");
   stress_chaos_cluster(scale);
   std::printf("[race_stress] sharded loops + crypto pipelines (ISSUE 13)...\n");

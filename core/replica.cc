@@ -38,6 +38,9 @@ std::optional<ClusterConfig> ClusterConfig::from_json_text(
   if (const Json* v = j->find("fastpath"); v && v->is_string())
     cfg.fastpath = v->as_string();
   if (const Json* v = j->find("tentative")) cfg.tentative = v->as_bool();
+  if (const Json* v = j->find("wal_dir"); v && v->is_string())
+    cfg.wal_dir = v->as_string();
+  if (const Json* v = j->find("wal_fsync")) cfg.wal_fsync = v->as_bool();
   if (const Json* v = j->find("verifier"); v && v->is_string())
     cfg.verifier = v->as_string();
   if (const Json* v = j->find("secure")) cfg.secure = v->as_bool();
@@ -155,6 +158,14 @@ Actions Replica::flush_open_batch() {
 
 Actions Replica::seal_batch() {
   if (seq_counter_ + 1 > high_mark()) return {};  // window closed: stay open
+  if (wal_ != nullptr &&
+      !wal_->note_vote(kWalVotePrePrepare, view_, seq_counter_ + 1,
+                       batch_digest_hex(open_batch_))) {
+    // A durable pre-prepare for this (view, seq) names a DIFFERENT
+    // batch: sealing would equivocate. Leave the batch open; the
+    // watermark / view machinery resolves the slot.
+    return {};
+  }
   std::vector<ClientRequest> batch;
   batch.swap(open_batch_);
   open_batch_ts_.clear();
@@ -321,6 +332,18 @@ Actions Replica::on_pre_prepare(const PrePrepare& pp) {
 
 Actions Replica::accept_pre_prepare(const PrePrepare& pp) {
   Key key{pp.view, pp.seq};
+  if (wal_ != nullptr) {
+    // Amnesia guard (ISSUE 15): our durable vote for this slot — the
+    // pre-prepare we sealed as primary, or the prepare we broadcast as
+    // backup — is the floor a restart must honor. A pre-prepare naming
+    // a different digest is refused outright; one naming the SAME
+    // digest re-enters normally, which is how a recovered replica
+    // resumes the round without re-voting anything new.
+    const uint8_t kind = config_.primary_of(pp.view) == id_
+                             ? kWalVotePrePrepare
+                             : kWalVotePrepare;
+    if (!wal_->note_vote(kind, pp.view, pp.seq, pp.digest)) return {};
+  }
   pre_prepares_.emplace(key, pp);
   counters["pre_prepares_accepted"] += 1;
   if (phase_hook) phase_hook("pre_prepare", pp.view, pp.seq);
@@ -374,6 +397,11 @@ bool Replica::prepared(const Key& key) const {
 
 Actions Replica::maybe_commit(const Key& key) {
   if (sent_commit_.count(key) || !prepared(key)) return {};
+  if (wal_ != nullptr &&
+      !wal_->note_vote(kWalVoteCommit, key.first, key.second,
+                       pre_prepares_.at(key).digest)) {
+    return {};  // contradicts a durable commit vote: never send
+  }
   sent_commit_.insert(key);
   if (phase_hook) phase_hook("prepared", key.first, key.second);
   Commit cm;
@@ -713,8 +741,17 @@ Actions Replica::on_state_response(const StateResponse& resp) {
   uint8_t d[32];
   blake2b_256(d, (const uint8_t*)resp.snapshot.data(), resp.snapshot.size());
   if (to_hex(d, 32) != awaiting_state_->second) return {};  // not certified
-  auto j = Json::parse(resp.snapshot);
-  if (!j || !j->is_object()) return {};
+  if (!install_checkpoint_payload(resp.seq, resp.snapshot)) return {};
+  awaiting_state_.reset();
+  counters["state_transfers"] += 1;
+  wal_checkpoint(resp.seq);
+  return drain_executions();
+}
+
+bool Replica::install_checkpoint_payload(int64_t seq,
+                                         const std::string& snapshot) {
+  auto j = Json::parse(snapshot);
+  if (!j || !j->is_object()) return false;
   const Json* app = j->find("app");
   const Json* chain = j->find("chain");
   const Json* replies = j->find("replies");
@@ -750,18 +787,45 @@ Actions Replica::on_state_response(const StateResponse& resp) {
   std::memcpy(state_digest_, chain_bytes, 32);
   last_reply_ = std::move(new_replies);
   last_timestamp_ = std::move(new_timestamps);
-  executed_upto_ = resp.seq;
-  // The fetched state is 2f+1-certified: the committed floor moves with
-  // it and any stale tentative bookkeeping dies here.
-  committed_upto_ = resp.seq;
+  executed_upto_ = seq;
+  // The installed state is 2f+1-certified: the committed floor moves
+  // with it and any stale tentative bookkeeping dies here.
+  committed_upto_ = seq;
   std::memcpy(committed_chain_, chain_bytes, 32);
   tentative_undo_.clear();
   committed_seqs_.clear();
   pending_checkpoints_.clear();
-  snapshots_[resp.seq] = resp.snapshot;  // we can serve peers now
-  awaiting_state_.reset();
-  counters["state_transfers"] += 1;
-  return drain_executions();
+  snapshots_[seq] = snapshot;  // we can serve peers now
+  return true;
+}
+
+bool Replica::restore_from_wal(const WalState& state) {
+  // Crash-recovery (ISSUE 15; mirrors consensus/replica.py
+  // restore_from_wal): reinstall the stable checkpoint wholesale, then
+  // re-join the SAME view at that floor — the wal's vote log refuses
+  // any send contradicting a pre-crash vote, and the suffix past the
+  // checkpoint catches up through the ordinary protocol. A crash
+  // mid-view-change re-joins at the OLD view (its VIEW-CHANGE vote, if
+  // it got out, already counts; duplicates are ignored; a completed
+  // change arrives as a NEW-VIEW for a higher view).
+  bool ok = true;
+  if (state.has_checkpoint) {
+    if (install_checkpoint_payload(state.checkpoint_seq,
+                                   state.checkpoint_payload)) {
+      low_mark_ = state.checkpoint_seq;
+      if (auto cert = Json::parse(state.checkpoint_cert);
+          cert && cert->is_array()) {
+        stable_proof_ = cert->as_array();
+      }
+      seq_counter_ = state.checkpoint_seq;
+    } else {
+      ok = false;  // start fresh: state transfer still covers it
+    }
+  }
+  view_ = std::max(view_, state.view);
+  // Never re-assign a sequence a previous life pre-prepared.
+  seq_counter_ = std::max(seq_counter_, state.max_pre_prepare_seq());
+  return ok;
 }
 
 Actions Replica::retry_state_transfer() {
@@ -806,10 +870,22 @@ Actions Replica::insert_checkpoint(const Checkpoint& cp) {
       }
       out.merge(advance_watermark(cp.seq, d));
       stable_proof_ = std::move(proof);
+      wal_checkpoint(cp.seq);
       break;
     }
   }
   return out;
+}
+
+void Replica::wal_checkpoint(int64_t seq) {
+  // Persist the stable checkpoint (ISSUE 15): payload (app snapshot +
+  // reply cache) and the adopted 2f+1 certificate. Skipped when we
+  // don't HOLD the payload yet (a lagging replica mid state transfer
+  // records it when the StateResponse installs).
+  if (wal_ == nullptr) return;
+  auto it = snapshots_.find(seq);
+  if (it == snapshots_.end()) return;
+  wal_->note_checkpoint(seq, it->second, Json(stable_proof_).dump());
 }
 
 Actions Replica::advance_watermark(int64_t stable_seq,
@@ -897,6 +973,7 @@ Actions Replica::start_view_change(int64_t new_view) {
   if (v <= floor) return {};
   in_view_change_ = true;
   pending_view_ = v;
+  if (wal_ != nullptr) wal_->note_view(view_, true, v);
   counters["view_changes_started"] += 1;
   if (view_hook) view_hook("view_change_sent", v);
   ViewChange vc;
@@ -1242,6 +1319,7 @@ Actions Replica::enter_new_view(int64_t v, int64_t min_s,
   view_ = v;
   in_view_change_ = false;
   pending_view_ = 0;
+  if (wal_ != nullptr) wal_->note_view(v, false, 0);
   my_view_change_.reset();
   // Keep only the NEW-VIEW for the view we just entered (a laggard's
   // retransmitted VIEW-CHANGE may still ask for it); older entries can

@@ -22,6 +22,7 @@
 
 #include "messages.h"
 #include "verifier.h"
+#include "wal.h"
 
 namespace pbft {
 
@@ -86,6 +87,15 @@ struct ClusterConfig {
   // view change (clients accept 2f+1 matching tentative votes).
   std::string fastpath = "sig";
   bool tentative = false;
+  // Durable replica recovery (ISSUE 15; defaults constants-linted
+  // against consensus/config.py): a non-empty wal_dir gives each
+  // replica a write-ahead log at {wal_dir}/replica-{id}.wal (view, sent
+  // votes, stable checkpoint + snapshot), group-commit flushed at the
+  // emit boundary and replayed on restart so a kill -9'd replica
+  // re-joins the SAME view without contradicting a persisted vote.
+  // wal_fsync=false keeps the writes but skips the fsync.
+  std::string wal_dir = "";
+  bool wal_fsync = true;
   std::string verifier = "cpu";  // "cpu" | "host:port" | "/unix/path"
   // Encrypted replica-replica links (core/secure.cc; the reference's
   // development_transport bundles Noise on every link, src/main.rs:42).
@@ -233,6 +243,17 @@ class Replica {
   bool awaiting_state() const { return awaiting_state_.has_value(); }
   Actions retry_state_transfer();
 
+  // Write-ahead log (ISSUE 15, core/wal.{h,cc}): when set, every vote
+  // this replica sends is recorded (durable before the send — the net
+  // layer flushes at its emit boundary) and a vote contradicting a
+  // persisted one is refused. nullptr = the pre-durability behavior.
+  void set_wal(Wal* w) { wal_ = w; }
+  // Crash-recovery: reinstall the durable state a previous life
+  // persisted (stable checkpoint wholesale + the view floor) BEFORE
+  // networking starts; the suffix catches up via §5.3 state transfer.
+  // False when the persisted checkpoint payload fails to parse.
+  bool restore_from_wal(const WalState& state);
+
  private:
   using Key = std::pair<int64_t, int64_t>;  // (view, seq)
 
@@ -259,6 +280,12 @@ class Replica {
   std::string checkpoint_payload(int64_t seq) const;
   Actions on_state_request(const StateRequest& sr);
   Actions on_state_response(const StateResponse& resp);
+  // Install a certified checkpoint payload wholesale (state transfer +
+  // WAL recovery); false when it doesn't parse (nothing mutated).
+  bool install_checkpoint_payload(int64_t seq, const std::string& snapshot);
+  // Persist the stable checkpoint + adopted certificate when we hold
+  // the payload (ISSUE 15); no-op without a wal.
+  void wal_checkpoint(int64_t seq);
 
   // View change internals (mirrors pbft_tpu/consensus/replica.py; hot-path
   // signatures are batch-verified, rare view-change evidence inline).
@@ -291,6 +318,7 @@ class Replica {
   ClusterConfig config_;
   int64_t id_;
   uint8_t seed_[32];
+  Wal* wal_ = nullptr;  // not owned (ISSUE 15); nullptr = no durability
   int64_t view_ = 0;
   int64_t seq_counter_ = 0;
   int64_t low_mark_ = 0;

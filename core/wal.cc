@@ -1,0 +1,312 @@
+#include "wal.h"
+
+#include <fcntl.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <cstdio>
+#include <cstring>
+
+#include "messages.h"  // from_hex / to_hex
+
+namespace pbft {
+
+namespace {
+
+void put_u32(std::string* out, uint32_t v) {
+  for (int i = 0; i < 4; ++i) out->push_back((char)((v >> (8 * i)) & 0xFF));
+}
+
+void put_i64(std::string* out, int64_t v) {
+  uint64_t u = (uint64_t)v;
+  for (int i = 0; i < 8; ++i) out->push_back((char)((u >> (8 * i)) & 0xFF));
+}
+
+uint32_t get_u32(const char* p) {
+  uint32_t v = 0;
+  for (int i = 3; i >= 0; --i) v = (v << 8) | (uint8_t)p[i];
+  return v;
+}
+
+int64_t get_i64(const char* p) {
+  uint64_t v = 0;
+  for (int i = 7; i >= 0; --i) v = (v << 8) | (uint8_t)p[i];
+  return (int64_t)v;
+}
+
+void append_record(std::string* out, uint8_t tag, const std::string& payload) {
+  out->push_back((char)tag);
+  put_u32(out, (uint32_t)payload.size());
+  out->append(payload);
+}
+
+std::string encode_view(int64_t view, bool ivc, int64_t pending) {
+  std::string p;
+  put_i64(&p, view);
+  p.push_back(ivc ? 1 : 0);
+  put_i64(&p, pending);
+  std::string rec;
+  append_record(&rec, kWalRecView, p);
+  return rec;
+}
+
+std::string encode_vote(uint8_t kind, int64_t view, int64_t seq,
+                        const std::string& digest_hex) {
+  uint8_t digest[32] = {0};
+  from_hex(digest_hex, digest, 32);
+  std::string p;
+  p.push_back((char)kind);
+  put_i64(&p, view);
+  put_i64(&p, seq);
+  p.append((const char*)digest, 32);
+  std::string rec;
+  append_record(&rec, kWalRecVote, p);
+  return rec;
+}
+
+std::string encode_checkpoint(int64_t seq, const std::string& payload,
+                              const std::string& cert) {
+  std::string p;
+  put_i64(&p, seq);
+  put_u32(&p, (uint32_t)payload.size());
+  p.append(payload);
+  put_u32(&p, (uint32_t)cert.size());
+  p.append(cert);
+  std::string rec;
+  append_record(&rec, kWalRecCheckpoint, p);
+  return rec;
+}
+
+std::string header_bytes() {
+  std::string h(kWalMagic, 8);
+  put_u32(&h, kWalVersion);
+  return h;
+}
+
+// write + optional fsync; updates the byte/fsync tallies. false on error.
+bool write_file(const std::string& path, const std::string& data, bool append,
+                bool do_fsync, int64_t* bytes, int64_t* fsyncs) {
+  int flags = O_WRONLY | O_CREAT | (append ? O_APPEND : O_TRUNC);
+  int fd = ::open(path.c_str(), flags, 0644);
+  if (fd < 0) return false;
+  size_t off = 0;
+  while (off < data.size()) {
+    ssize_t n = ::write(fd, data.data() + off, data.size() - off);
+    if (n <= 0) {
+      ::close(fd);
+      return false;
+    }
+    off += (size_t)n;
+  }
+  *bytes += (int64_t)data.size();
+  if (do_fsync) {
+    ::fsync(fd);
+    *fsyncs += 1;
+  }
+  ::close(fd);
+  return true;
+}
+
+}  // namespace
+
+int64_t WalState::max_pre_prepare_seq() const {
+  int64_t best = 0;
+  for (const auto& [key, _] : votes) {
+    if (std::get<0>(key) == kWalVotePrePrepare) {
+      best = std::max(best, std::get<2>(key));
+    }
+  }
+  return best;
+}
+
+bool wal_decode(const std::string& data, WalState* out) {
+  *out = WalState();
+  if (data.size() < 12) return true;  // fresh / torn-before-header
+  if (std::memcmp(data.data(), kWalMagic, 8) != 0) return false;
+  if (get_u32(data.data() + 8) != kWalVersion) return false;
+  size_t off = 12;
+  while (off + 5 <= data.size()) {
+    uint8_t tag = (uint8_t)data[off];
+    uint32_t n = get_u32(data.data() + off + 1);
+    off += 5;
+    if (off + n > data.size()) break;  // torn tail record
+    const char* p = data.data() + off;
+    off += n;
+    if (tag == kWalRecView && n == 17) {
+      out->view = get_i64(p);
+      out->in_view_change = p[8] != 0;
+      out->pending_view = get_i64(p + 9);
+    } else if (tag == kWalRecVote && n == 49) {
+      uint8_t kind = (uint8_t)p[0];
+      int64_t view = get_i64(p + 1);
+      int64_t seq = get_i64(p + 9);
+      out->votes[{kind, view, seq}] = to_hex((const uint8_t*)p + 17, 32);
+    } else if (tag == kWalRecCheckpoint && n >= 16) {
+      int64_t seq = get_i64(p);
+      uint32_t plen = get_u32(p + 8);
+      if (12 + (size_t)plen + 4 > n) continue;  // malformed: skip
+      uint32_t clen = get_u32(p + 12 + plen);
+      if (16 + (size_t)plen + clen > n) continue;
+      out->has_checkpoint = true;
+      out->checkpoint_seq = seq;
+      out->checkpoint_payload.assign(p + 12, plen);
+      out->checkpoint_cert.assign(p + 16 + plen, clen);
+      // Votes at or below a stable checkpoint are beneath the watermark.
+      for (auto it = out->votes.begin(); it != out->votes.end();) {
+        if (std::get<2>(it->first) <= seq) it = out->votes.erase(it);
+        else ++it;
+      }
+    }
+    // Unknown tags / wrong-size payloads skip: forward compatibility.
+  }
+  return true;
+}
+
+bool Wal::open(const std::string& path, bool do_fsync) {
+  std::lock_guard<std::mutex> lk(mu_);
+  path_ = path;
+  fsync_ = do_fsync;
+  std::string data;
+  if (FILE* f = std::fopen(path.c_str(), "rb")) {
+    char buf[65536];
+    size_t r;
+    while ((r = std::fread(buf, 1, sizeof(buf), f)) > 0) data.append(buf, r);
+    std::fclose(f);
+  }
+  if (!wal_decode(data, &state_)) return false;
+  recovered_ = state_;
+  // Recovery compaction: start the new life from a bounded, cleanly
+  // terminated log (heals any torn tail record too).
+  compact_due_ = true;
+  return compact_locked();
+}
+
+bool Wal::note_vote(uint8_t kind, int64_t view, int64_t seq,
+                    const std::string& digest_hex) {
+  std::lock_guard<std::mutex> lk(mu_);
+  auto key = std::make_tuple(kind, view, seq);
+  auto it = state_.votes.find(key);
+  if (it != state_.votes.end()) return it->second == digest_hex;
+  state_.votes.emplace(key, digest_hex);
+  pending_.push_back(encode_vote(kind, view, seq, digest_hex));
+  ++appends_;
+  return true;
+}
+
+std::optional<std::string> Wal::vote_digest(uint8_t kind, int64_t view,
+                                            int64_t seq) const {
+  std::lock_guard<std::mutex> lk(mu_);
+  auto it = state_.votes.find({kind, view, seq});
+  if (it == state_.votes.end()) return std::nullopt;
+  return it->second;
+}
+
+void Wal::note_view(int64_t view, bool in_view_change, int64_t pending) {
+  std::lock_guard<std::mutex> lk(mu_);
+  if (state_.view == view && state_.in_view_change == in_view_change &&
+      state_.pending_view == pending) {
+    return;
+  }
+  state_.view = view;
+  state_.in_view_change = in_view_change;
+  state_.pending_view = pending;
+  pending_.push_back(encode_view(view, in_view_change, pending));
+  ++appends_;
+}
+
+void Wal::note_checkpoint(int64_t seq, const std::string& payload,
+                          const std::string& cert_json) {
+  std::lock_guard<std::mutex> lk(mu_);
+  if (state_.has_checkpoint && state_.checkpoint_seq >= seq) return;
+  state_.has_checkpoint = true;
+  state_.checkpoint_seq = seq;
+  state_.checkpoint_payload = payload;
+  state_.checkpoint_cert = cert_json;
+  for (auto it = state_.votes.begin(); it != state_.votes.end();) {
+    if (std::get<2>(it->first) <= seq) it = state_.votes.erase(it);
+    else ++it;
+  }
+  pending_.push_back(encode_checkpoint(seq, payload, cert_json));
+  ++appends_;
+  compact_due_ = true;
+}
+
+size_t Wal::pending() const {
+  std::lock_guard<std::mutex> lk(mu_);
+  return pending_.size();
+}
+
+void Wal::flush() {
+  std::lock_guard<std::mutex> lk(mu_);
+  if (pending_.empty() && !compact_due_) return;
+  if (path_.empty()) {  // in-memory mode (tests): the object is the disk
+    pending_.clear();
+    compact_due_ = false;
+    return;
+  }
+  if (compact_due_) {
+    compact_locked();
+    return;
+  }
+  std::string data;
+  for (const auto& rec : pending_) data.append(rec);
+  pending_.clear();
+  write_file(path_, data, /*append=*/true, fsync_, &bytes_written_, &fsyncs_);
+}
+
+bool Wal::compact_locked() {
+  pending_.clear();
+  compact_due_ = false;
+  if (path_.empty()) return true;
+  std::string data = header_bytes();
+  data.append(
+      encode_view(state_.view, state_.in_view_change, state_.pending_view));
+  if (state_.has_checkpoint) {
+    data.append(encode_checkpoint(state_.checkpoint_seq,
+                                  state_.checkpoint_payload,
+                                  state_.checkpoint_cert));
+  }
+  // (view, seq, kind) order mirrors consensus/wal.py's compaction sort.
+  std::map<std::tuple<int64_t, int64_t, uint8_t>, std::string> ordered;
+  for (const auto& [key, digest] : state_.votes) {
+    ordered[{std::get<1>(key), std::get<2>(key), std::get<0>(key)}] =
+        encode_vote(std::get<0>(key), std::get<1>(key), std::get<2>(key),
+                    digest);
+  }
+  for (const auto& [_, rec] : ordered) data.append(rec);
+  const std::string tmp = path_ + ".tmp";
+  if (!write_file(tmp, data, /*append=*/false, fsync_, &bytes_written_,
+                  &fsyncs_)) {
+    return false;
+  }
+  ::rename(tmp.c_str(), path_.c_str());
+  if (fsync_) {
+    // The rename must be durable too, or a crash resurrects the
+    // pre-compaction file without the records appended since.
+    std::string dir = path_;
+    size_t slash = dir.find_last_of('/');
+    dir = slash == std::string::npos ? "." : dir.substr(0, slash);
+    int dfd = ::open(dir.c_str(), O_RDONLY);
+    if (dfd >= 0) {
+      ::fsync(dfd);
+      ++fsyncs_;
+      ::close(dfd);
+    }
+  }
+  return true;
+}
+
+int64_t Wal::appends() const {
+  std::lock_guard<std::mutex> lk(mu_);
+  return appends_;
+}
+int64_t Wal::fsyncs() const {
+  std::lock_guard<std::mutex> lk(mu_);
+  return fsyncs_;
+}
+int64_t Wal::bytes_written() const {
+  std::lock_guard<std::mutex> lk(mu_);
+  return bytes_written_;
+}
+
+}  // namespace pbft

@@ -1,0 +1,124 @@
+// Per-replica write-ahead log: durable safety state for crash-restart
+// (ISSUE 15; PBFT §4.3's stable-storage message log). Byte-identical
+// on-disk format with pbft_tpu/consensus/wal.py — magic, version, record
+// tags and vote kinds are constants-linted (analysis/constants.py):
+//
+//   header  kWalMagic (8B) + u32le version
+//   record  u8 tag + u32le payload length + payload
+//     view        (0x01)  i64le view + u8 in_view_change + i64le pending
+//     vote        (0x02)  u8 kind + i64le view + i64le seq + 32B digest
+//     checkpoint  (0x03)  i64le seq + u32le len + payload
+//                         + u32le len + certificate JSON
+//
+// Durability model (group commit): note_* appends records to an
+// in-memory buffer and updates the live mirror the replica's
+// no-contradiction guards consult; the net layer calls flush() at the
+// emit boundary — BEFORE any of that pass's votes reach a socket — so
+// one write+fsync covers a whole verify batch's votes. Only the tail
+// record can be torn (append-only writes); replay stops there. Every
+// stable checkpoint schedules a compaction (tmp + fsync + rename) that
+// bounds the file by the watermark window.
+//
+// Thread safety: every method locks — the consensus thread is the only
+// writer in production, but race_stress.cc hammers append/flush/replay
+// concurrently and the lock keeps the file image coherent under it.
+#pragma once
+
+#include <cstdint>
+#include <map>
+#include <mutex>
+#include <optional>
+#include <string>
+#include <tuple>
+#include <vector>
+
+namespace pbft {
+
+inline constexpr const char* kWalMagic = "PBFTWAL1";
+inline constexpr uint32_t kWalVersion = 1;
+// Record tags (cross-runtime contract with consensus/wal.py).
+inline constexpr uint8_t kWalRecView = 0x01;
+inline constexpr uint8_t kWalRecVote = 0x02;
+inline constexpr uint8_t kWalRecCheckpoint = 0x03;
+// Vote kinds inside a vote record.
+inline constexpr uint8_t kWalVotePrePrepare = 1;
+inline constexpr uint8_t kWalVotePrepare = 2;
+inline constexpr uint8_t kWalVoteCommit = 3;
+
+// What a replay recovered: the state a restarted replica reinstalls.
+struct WalState {
+  int64_t view = 0;
+  bool in_view_change = false;
+  int64_t pending_view = 0;
+  // (kind, view, seq) -> digest hex — the votes this replica sent.
+  std::map<std::tuple<uint8_t, int64_t, int64_t>, std::string> votes;
+  bool has_checkpoint = false;
+  int64_t checkpoint_seq = 0;
+  std::string checkpoint_payload;  // canonical checkpoint JSON (app+replies)
+  std::string checkpoint_cert;     // 2f+1 certificate, canonical JSON array
+
+  bool empty() const {
+    return view == 0 && !in_view_change && votes.empty() && !has_checkpoint;
+  }
+  // Highest sequence this replica (as primary) pre-prepared — a
+  // recovered primary must never re-assign one of these.
+  int64_t max_pre_prepare_seq() const;
+};
+
+// Replay a log image; tolerates a torn tail record. Returns false (and
+// leaves *out empty) on a wrong magic/version — corruption, not a tear.
+bool wal_decode(const std::string& data, WalState* out);
+
+class Wal {
+ public:
+  Wal() = default;
+
+  // Open (replay, then compact) the log at `path`. do_fsync=false keeps
+  // the writes but skips fsync — kill -9 of the process stays safe via
+  // the page cache; only host power loss can drop the tail. Returns
+  // false when the existing file is corrupt or the path is unwritable.
+  bool open(const std::string& path, bool do_fsync);
+
+  // The frozen replay snapshot recovery installs (empty on a fresh log).
+  const WalState& recovered() const { return recovered_; }
+
+  // Record a vote about to be sent. False — and nothing recorded — when
+  // a durable vote for the same (kind, view, seq) names a DIFFERENT
+  // digest: the caller must not send. Identical repeats are free.
+  bool note_vote(uint8_t kind, int64_t view, int64_t seq,
+                 const std::string& digest_hex);
+  // nullopt when no vote is held for the slot.
+  std::optional<std::string> vote_digest(uint8_t kind, int64_t view,
+                                         int64_t seq) const;
+  void note_view(int64_t view, bool in_view_change, int64_t pending);
+  // A 2f+1-certified stable checkpoint: prunes votes <= seq, schedules
+  // a compaction for the next flush.
+  void note_checkpoint(int64_t seq, const std::string& payload,
+                       const std::string& cert_json);
+
+  size_t pending() const;
+  // THE durability point (group commit): one write + one fsync for
+  // everything accumulated; a due compaction replaces the append.
+  void flush();
+
+  // Metric feeds (pbft_wal_{appends,fsyncs,bytes}_total).
+  int64_t appends() const;
+  int64_t fsyncs() const;
+  int64_t bytes_written() const;
+
+ private:
+  bool compact_locked();
+
+  mutable std::mutex mu_;
+  std::string path_;
+  bool fsync_ = true;
+  bool compact_due_ = false;
+  WalState state_;      // live mirror (the guards' source of truth)
+  WalState recovered_;  // frozen replay snapshot
+  std::vector<std::string> pending_;
+  int64_t appends_ = 0;
+  int64_t fsyncs_ = 0;
+  int64_t bytes_written_ = 0;
+};
+
+}  // namespace pbft

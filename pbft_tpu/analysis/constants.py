@@ -161,6 +161,41 @@ PAIRS: List[Tuple[str, Tuple[str, str], Tuple[str, str]]] = [
     ("ClusterConfig default: tentative",
      ("core/replica.h", "tentative"),
      ("pbft_tpu/consensus/config.py", "tentative")),
+    # Durable replica recovery (ISSUE 15): the WAL's on-disk format is
+    # byte-identical across runtimes (a pbftd-written log must replay in
+    # the asyncio runtime's tooling and vice versa) — magic, version,
+    # record tags, and vote kinds are all hand-mirrored; and a sparse
+    # network.json must mean no-WAL + fsync-on identically in both.
+    ("WAL file magic",
+     ("core/wal.h", "kWalMagic"),
+     ("pbft_tpu/consensus/wal.py", "WAL_MAGIC")),
+    ("WAL format version",
+     ("core/wal.h", "kWalVersion"),
+     ("pbft_tpu/consensus/wal.py", "WAL_VERSION")),
+    ("WAL record tag: view",
+     ("core/wal.h", "kWalRecView"),
+     ("pbft_tpu/consensus/wal.py", "WAL_REC_VIEW")),
+    ("WAL record tag: vote",
+     ("core/wal.h", "kWalRecVote"),
+     ("pbft_tpu/consensus/wal.py", "WAL_REC_VOTE")),
+    ("WAL record tag: checkpoint",
+     ("core/wal.h", "kWalRecCheckpoint"),
+     ("pbft_tpu/consensus/wal.py", "WAL_REC_CHECKPOINT")),
+    ("WAL vote kind: pre-prepare",
+     ("core/wal.h", "kWalVotePrePrepare"),
+     ("pbft_tpu/consensus/wal.py", "WAL_VOTE_PRE_PREPARE")),
+    ("WAL vote kind: prepare",
+     ("core/wal.h", "kWalVotePrepare"),
+     ("pbft_tpu/consensus/wal.py", "WAL_VOTE_PREPARE")),
+    ("WAL vote kind: commit",
+     ("core/wal.h", "kWalVoteCommit"),
+     ("pbft_tpu/consensus/wal.py", "WAL_VOTE_COMMIT")),
+    ("ClusterConfig default: wal_dir",
+     ("core/replica.h", "wal_dir"),
+     ("pbft_tpu/consensus/config.py", "wal_dir")),
+    ("ClusterConfig default: wal_fsync",
+     ("core/replica.h", "wal_fsync"),
+     ("pbft_tpu/consensus/config.py", "wal_fsync")),
     # ISSUE 12: forwarded-request retention (view-change re-aim) bound —
     # same eviction point in both runtimes or their storm behavior forks.
     ("forwarded-request retention bound",

@@ -80,6 +80,19 @@ class ClusterConfig:
     # clients then accept a 2f+1 matching tentative-reply quorum.
     fastpath: str = "sig"
     tentative: bool = False
+    # Durable replica recovery (ISSUE 15): when wal_dir is non-empty each
+    # replica keeps a write-ahead log at {wal_dir}/replica-{id}.wal —
+    # current view, sent votes (digest only), latest stable checkpoint
+    # certificate + snapshot — flushed with group-commit fsync batching
+    # at the runtime's emit boundary, and replayed on restart so a
+    # kill -9'd replica re-joins the SAME view without ever contradicting
+    # a persisted vote. wal_fsync=False keeps the writes but skips the
+    # fsync (kill -9 of the process stays safe via the page cache; only
+    # host power loss can drop the tail) — the A/B lever that makes the
+    # durability cost visible in the bench. Defaults constants-linted
+    # against core/replica.h.
+    wal_dir: str = ""
+    wal_fsync: bool = True
     verifier: str = "cpu"  # "cpu" | "tpu"
     # Encrypted replica-replica links (signed-ephemeral DH + AEAD framing,
     # pbft_tpu/net/secure.py) — the reference's development_transport
@@ -115,6 +128,8 @@ class ClusterConfig:
                 "net_threads": self.net_threads,
                 "fastpath": self.fastpath,
                 "tentative": self.tentative,
+                "wal_dir": self.wal_dir,
+                "wal_fsync": self.wal_fsync,
                 "verifier": self.verifier,
                 "secure": self.secure,
                 "replicas": [dataclasses.asdict(r) for r in self.replicas],
@@ -139,6 +154,8 @@ class ClusterConfig:
             net_threads=d.get("net_threads", 1),
             fastpath=d.get("fastpath", "sig"),
             tentative=bool(d.get("tentative", False)),
+            wal_dir=d.get("wal_dir", ""),
+            wal_fsync=bool(d.get("wal_fsync", True)),
             verifier=d.get("verifier", "cpu"),
             secure=bool(d.get("secure", False)),
         )

@@ -29,6 +29,7 @@ ACTIONS = (
     "heal",  # args: []
     "crash",  # args: [rid]
     "revive",  # args: [rid]
+    "restart",  # args: [rid, from_disk] — process restart (ISSUE 15)
     "set_fault",  # args: [rid, mode]
     "clear_fault",  # args: [rid]
     "chaos",  # args: [drop_pct, dup_pct, delay_min, delay_max]
@@ -83,6 +84,11 @@ class FaultSchedule:
             cluster.crash(a[0])
         elif ev.action == "revive":
             cluster.uncrash(a[0])
+        elif ev.action == "restart":
+            # Process restart (ISSUE 15): the Replica object dies; the
+            # replacement replays its write-ahead log (from_disk) or
+            # comes back amnesiac — the S5 checker watches either way.
+            cluster.restart(a[0], bool(a[1]))
         elif ev.action == "set_fault":
             cluster.set_fault(a[0], a[1])
         elif ev.action == "clear_fault":
@@ -118,6 +124,7 @@ def random_schedule(
     max_faulty: Optional[int] = None,
     events_every: int = 20,
     modes: Tuple[str, ...] = FAULT_MODES,
+    restart_from_disk: bool = False,
 ) -> FaultSchedule:
     """A seeded nemesis timeline over ``steps`` scheduler rounds.
 
@@ -125,7 +132,13 @@ def random_schedule(
     checker's job): crashed+Byzantine replicas never exceed ``max_faulty``
     (default f = (n-1)//3), and a trailing cleanup block heals partitions,
     revives crashes, clears faults, and turns link chaos off so the
-    recovery phase starts from a connected, fault-free cluster."""
+    recovery phase starts from a connected, fault-free cluster.
+
+    ``restart_from_disk`` (ISSUE 15): every recovery from a crash becomes
+    a PROCESS RESTART from the write-ahead log ("restart" events,
+    from_disk=True) instead of a memory-intact resume — the seeded
+    crash-restart fault mode the chaos soak's S5 matrix drives (requires
+    a Cluster built with wal=True)."""
     rng = random.Random(seed)
     f = (n - 1) // 3
     budget = f if max_faulty is None else max_faulty
@@ -160,7 +173,10 @@ def random_schedule(
         elif roll < 0.58 and crashed:
             victim = rng.choice(sorted(crashed))
             crashed.discard(victim)
-            events.append(FaultEvent(step, "revive", (victim,)))
+            if restart_from_disk:
+                events.append(FaultEvent(step, "restart", (victim, True)))
+            else:
+                events.append(FaultEvent(step, "revive", (victim,)))
         elif roll < 0.75 and spend() < budget:
             victim = rng.choice([r for r in range(n) if r not in crashed | faulty])
             mode = rng.choice(list(modes))
@@ -189,7 +205,10 @@ def random_schedule(
     if partitioned:
         events.append(FaultEvent(cleanup, "heal", ()))
     for rid in sorted(crashed):
-        events.append(FaultEvent(cleanup, "revive", (rid,)))
+        if restart_from_disk:
+            events.append(FaultEvent(cleanup, "restart", (rid, True)))
+        else:
+            events.append(FaultEvent(cleanup, "revive", (rid,)))
     for rid in sorted(faulty):
         events.append(FaultEvent(cleanup, "clear_fault", (rid,)))
     events.append(FaultEvent(cleanup, "chaos", (0.0, 0.0, 0, 0)))

@@ -27,6 +27,13 @@ S3  executed => committed-with-quorum — an honest replica only advances
     or beyond it exists (state-transfer catch-up). Evidence is tallied from
     messages replicas SEND (the cluster's sent_observer feed), so link-level
     drops cannot mask a quorum that never existed.
+S5  restart never double-votes (ISSUE 15) — a replica restarted from its
+    write-ahead log never sends a pre-prepare/prepare/commit whose digest
+    contradicts a vote it had PERSISTED before the crash (same kind, view,
+    seq, different digest). The pre-crash vote map is snapshotted by
+    ``Cluster.restart``; every post-restart send is checked against it.
+    An amnesiac (fresh-state) restart is exactly what can violate this —
+    which is why the checker exists.
 
 The liveness invariant:
 
@@ -104,12 +111,33 @@ class InvariantChecker:
         # S2 evidence: (rid, client, timestamp) -> result.
         self._reply_results: Dict[Tuple[int, str, int], str] = {}
         self._replies_seen = 0
+        # S5 (ISSUE 15): contradictions observed on the wire are queued
+        # here (observe() runs inside message delivery, where raising
+        # would corrupt the transport) and raised by the next check().
+        # _seen_restarts re-baselines the monotonicity tracking when a
+        # replica is restarted (its executed_upto legally drops to the
+        # recovered checkpoint floor).
+        self._s5_pending: List[str] = []
+        self._seen_restarts: Dict[int, int] = {}
         self.violations: List[InvariantViolation] = []
+        _VOTE_KINDS = {PrePrepare: 1, Prepare: 2, Commit: 3}
         prev = cluster.sent_observer
 
         def observe(src: int, msg) -> None:
             if prev is not None:
                 prev(src, msg)
+            kind = _VOTE_KINDS.get(type(msg))
+            if kind is not None:
+                held = self.cluster.restart_votes.get(src, {}).get(
+                    (kind, msg.view, msg.seq)
+                ) if hasattr(self.cluster, "restart_votes") else None
+                if held is not None and held != msg.digest:
+                    self._s5_pending.append(
+                        f"replica {src} sent {type(msg).__name__} "
+                        f"(v={msg.view}, n={msg.seq}) digest "
+                        f"{msg.digest[:16]}.. contradicting its persisted "
+                        f"pre-crash vote {held[:16]}.."
+                    )
             if isinstance(msg, Commit):
                 self.commit_senders.setdefault(
                     (msg.view, msg.seq, msg.digest), set()
@@ -168,10 +196,26 @@ class InvariantChecker:
     # -- the per-step safety pass -------------------------------------------
 
     def check(self) -> None:
-        """Run S1-S3 against current cluster state; raises
-        InvariantViolation on the first failure."""
+        """Run S1-S3 (+ S5 under crash-restart schedules) against current
+        cluster state; raises InvariantViolation on the first failure."""
         honest = self.honest()
         quorum = self._quorum()
+        # S5 first: a wire-observed double vote is the gravest finding.
+        if self._s5_pending:
+            self._fail("restart-vote-contradiction", self._s5_pending[0])
+        # A restart legally drops executed_upto to the recovered
+        # checkpoint floor: re-baseline the monotonicity tracking for
+        # restarted replicas (ISSUE 15). Pre-crash S1 digest evidence
+        # stays — those executions happened and re-execution of the same
+        # sequences must reproduce the same digests.
+        for rid, epoch in getattr(
+            self.cluster, "restart_epochs", {}
+        ).items():
+            if self._seen_restarts.get(rid) != epoch:
+                self._seen_restarts[rid] = epoch
+                r = self.cluster.replicas[rid]
+                self._last_executed[rid] = r.executed_upto
+                self._last_committed[rid] = r.committed_upto
         for r in self.cluster.replicas:
             rid = r.id
             prev = self._last_executed[rid]

@@ -32,6 +32,12 @@ from typing import Callable, Dict, List, Optional, Set, Tuple
 
 from ..crypto import ref as crypto
 from .config import ClusterConfig
+from .wal import (
+    WAL_VOTE_COMMIT,
+    WAL_VOTE_PRE_PREPARE,
+    WAL_VOTE_PREPARE,
+    WalState,
+)
 from .messages import (
     NULL_CLIENT,
     Checkpoint,
@@ -239,6 +245,13 @@ class Replica:
         # retransmission timer re-broadcasts it verbatim instead of
         # escalating on every expiry (ISSUE 12, §4.5 liveness under loss).
         self._my_view_change: Optional[ViewChange] = None
+        # Write-ahead log (ISSUE 15, consensus/wal.py): when set by the
+        # runtime, every vote this replica sends is recorded (and durable
+        # before the send — the runtime flushes at its emit boundary),
+        # and a vote contradicting a persisted one is REFUSED: the
+        # amnesia guard that makes crash-restart safe. None = the
+        # pre-durability behavior, one attribute check per vote.
+        self.wal = None
         # (message, optional precomputed signable digest) — see receive().
         self._inbox: List[Tuple[Message, Optional[bytes]]] = []
         # Consensus-phase observer (utils.metrics.ConsensusSpans.on_phase):
@@ -404,6 +417,18 @@ class Replica:
         if self.seq_counter + 1 > self.high_mark:
             return []  # out of window until a checkpoint advances it
         batch = tuple(self._open_batch)
+        if self.wal is not None and not self.wal.note_vote(
+            WAL_VOTE_PRE_PREPARE,
+            self.view,
+            self.seq_counter + 1,
+            batch_digest(batch),
+        ):
+            # A durable pre-prepare for this (view, seq) names a
+            # DIFFERENT batch (can only happen if recovery restored a
+            # lower seq_counter than the log proves we used): sealing
+            # would equivocate. Leave the batch open; the watermark /
+            # view machinery resolves the slot.
+            return []
         self._open_batch = []
         self._open_batch_ts = {}
         for req in batch:
@@ -568,6 +593,22 @@ class Replica:
 
     def _accept_pre_prepare(self, pp: PrePrepare) -> List[Action]:
         key = (pp.view, pp.seq)
+        if self.wal is not None:
+            # Amnesia guard (ISSUE 15): our durable vote for this slot —
+            # the pre-prepare we sealed as primary, or the prepare we
+            # broadcast as backup — is the floor a restart must honor. A
+            # pre-prepare naming a different digest is refused outright
+            # (accepting it could grow a conflicting certificate); one
+            # naming the SAME digest re-enters normally, which is how a
+            # recovered replica resumes the round without re-voting
+            # anything new.
+            kind = (
+                WAL_VOTE_PRE_PREPARE
+                if self.config.primary_of(pp.view) == self.id
+                else WAL_VOTE_PREPARE
+            )
+            if not self.wal.note_vote(kind, pp.view, pp.seq, pp.digest):
+                return []
         self.pre_prepares[key] = pp
         self.counters["pre_prepares_accepted"] += 1
         hook = self.phase_hook
@@ -624,6 +665,10 @@ class Replica:
     def _maybe_commit(self, key: Tuple[int, int]) -> List[Action]:
         if key in self.sent_commit or not self._prepared(key):
             return []
+        if self.wal is not None and not self.wal.note_vote(
+            WAL_VOTE_COMMIT, key[0], key[1], self.pre_prepares[key].digest
+        ):
+            return []  # contradicts a durable commit vote: never send
         self.sent_commit.add(key)
         hook = self.phase_hook
         if hook is not None:
@@ -945,23 +990,20 @@ class Replica:
         )
         return [Send(sr.replica, resp)]
 
-    def _on_state_response(self, resp: StateResponse) -> List[Action]:
-        if self.awaiting_state is None:
-            return []
-        seq, digest = self.awaiting_state
-        if resp.seq != seq:
-            return []
-        if blake2b_256(resp.snapshot.encode()).hex() != digest:
-            return []  # content not certified by the 2f+1 checkpoint quorum
+    def _install_checkpoint_payload(self, seq: int, snapshot: str) -> bool:
+        """Install a certified checkpoint payload wholesale: app state,
+        chain digest, per-client exactly-once caches, committed floor.
+        Shared by §5.3 state transfer and WAL crash-recovery (ISSUE 15).
+        False when the payload doesn't parse (nothing was mutated)."""
         try:
             import json as _json
 
-            obj = _json.loads(resp.snapshot)
+            obj = _json.loads(snapshot)
             replies = {}
             for c, d in obj["replies"]:
                 m = Message.from_dict(dict(d))
                 if not isinstance(m, ClientReply):
-                    return []
+                    return False
                 # Stamp our id back in and re-sign: a resent cached reply
                 # must carry THIS replica's vote, not a blank one.
                 replies[c] = self._sign(
@@ -970,7 +1012,7 @@ class Replica:
             timestamps = {c: int(t) for c, t in obj["timestamps"]}
             chain = bytes.fromhex(obj["chain"])
         except (KeyError, TypeError, ValueError):
-            return []
+            return False
         restore = getattr(self._app, "restore", None)
         if callable(restore):
             restore(obj.get("app", ""))
@@ -978,17 +1020,66 @@ class Replica:
         self.last_reply = replies
         self.last_timestamp = timestamps
         self.executed_upto = seq
-        # The fetched state is 2f+1-certified: the committed floor moves
-        # with it and any stale tentative bookkeeping dies here.
+        # The installed state is 2f+1-certified: the committed floor
+        # moves with it and any stale tentative bookkeeping dies here.
         self.committed_upto = seq
         self.committed_chain = chain
         self._tentative_undo.clear()
         self._committed_seqs.clear()
         self._pending_checkpoints.clear()
-        self.snapshots[seq] = resp.snapshot  # we can serve peers now
+        self.snapshots[seq] = snapshot  # we can serve peers now
+        return True
+
+    def _on_state_response(self, resp: StateResponse) -> List[Action]:
+        if self.awaiting_state is None:
+            return []
+        seq, digest = self.awaiting_state
+        if resp.seq != seq:
+            return []
+        if blake2b_256(resp.snapshot.encode()).hex() != digest:
+            return []  # content not certified by the 2f+1 checkpoint quorum
+        if not self._install_checkpoint_payload(seq, resp.snapshot):
+            return []
         self.awaiting_state = None
         self.counters["state_transfers"] += 1
+        self._wal_checkpoint(seq)
         return self._drain_executions()
+
+    def restore_from_wal(self, state: WalState) -> bool:
+        """Crash-recovery (ISSUE 15): reinstall the durable safety state a
+        previous life of this replica persisted — BEFORE the runtime
+        starts networking. The replica re-joins the SAME view at its
+        stable-checkpoint floor; the vote log (already loaded in
+        ``self.wal``) then refuses any send contradicting a pre-crash
+        vote, and the suffix past the checkpoint catches up through the
+        ordinary protocol (peer checkpoints -> §5.3 state transfer).
+
+        A crash mid-view-change re-joins at the OLD view, not the
+        pending one: its VIEW-CHANGE vote (if it got out) already counts
+        at the primary-elect, duplicates are ignored, and a completed
+        change reaches us as a NEW-VIEW for a higher view. Returns False
+        when the persisted checkpoint payload fails to parse (the
+        replica then starts fresh — state transfer still covers it)."""
+        ok = True
+        if state.checkpoint is not None:
+            seq, payload, cert_json = state.checkpoint
+            if self._install_checkpoint_payload(seq, payload):
+                self.low_mark = seq
+                try:
+                    import json as _json
+
+                    self.stable_proof = list(_json.loads(cert_json))
+                except ValueError:
+                    self.stable_proof = []
+                self.seq_counter = seq
+            else:
+                ok = False
+        self.view = max(self.view, state.view)
+        # Never re-assign a sequence a previous life pre-prepared: the
+        # durable vote guard would refuse the seal, but starting past the
+        # floor avoids even trying.
+        self.seq_counter = max(self.seq_counter, state.max_pre_prepare_seq())
+        return ok
 
     def _on_checkpoint(self, cp: Checkpoint) -> List[Action]:
         if cp.seq <= self.low_mark:
@@ -1025,8 +1116,20 @@ class Replica:
                 ]
                 out.extend(self._advance_watermark(cp.seq, digest))
                 self.stable_proof = proof
+                self._wal_checkpoint(cp.seq)
                 break
         return out
+
+    def _wal_checkpoint(self, seq: int) -> None:
+        """Persist the stable checkpoint (ISSUE 15): payload (app snapshot
+        + reply cache) and the adopted 2f+1 certificate. Skipped when we
+        don't HOLD the payload yet (a lagging replica mid state transfer
+        records it when the StateResponse installs)."""
+        if self.wal is None:
+            return
+        payload = self.snapshots.get(seq)
+        if payload is not None:
+            self.wal.note_checkpoint(seq, payload, self.stable_proof)
 
     # -- view change (PBFT §4.4) -------------------------------------------
     #
@@ -1062,6 +1165,8 @@ class Replica:
             return []
         self.in_view_change = True
         self.pending_view = v
+        if self.wal is not None:
+            self.wal.note_view(self.view, True, v)
         self.counters["view_changes_started"] += 1
         vh = self.view_hook
         if vh is not None:
@@ -1398,6 +1503,8 @@ class Replica:
         self.view = v
         self.in_view_change = False
         self.pending_view = 0
+        if self.wal is not None:
+            self.wal.note_view(v, False, 0)
         self._my_view_change = None
         # Keep only the NEW-VIEW for the view we just entered (the one a
         # laggard's retransmitted VIEW-CHANGE may still need); older
@@ -1419,6 +1526,7 @@ class Replica:
             # Adopt the certificate with the watermark: our next
             # VIEW-CHANGE's C component must certify THIS stable seq.
             self.stable_proof = stable_proof
+            self._wal_checkpoint(min_s)
         # The new primary continues the sequence after the re-issued slots;
         # harmless for backups (their seq_counter is unused until they lead).
         # low_mark is included: when this replica's stable checkpoint is

@@ -34,6 +34,7 @@ from .messages import (
     with_sig,
 )
 from .replica import Broadcast, Replica, Reply, Send, _host_sign
+from .wal import WriteAheadLog
 
 # Replica-level Byzantine behavior modes (the sim arm of the cross-runtime
 # --fault flag; core/pbftd.cc and net/server.py accept the same names).
@@ -88,11 +89,14 @@ class Cluster:
         app=None,
         app_factory: Optional[Callable[[], Callable]] = None,
         mode: str = "sig",
+        wal: bool = False,
     ):
         if config is None:
             config, seeds = make_local_cluster(n)
         self.config = config
         self.seeds = seeds
+        self._app = app
+        self._app_factory = app_factory
         # Fast-path authenticator mode (ISSUE 14): "mac" models the real
         # runtimes' per-link session MACs — the transport KNOWS each
         # message's true sender, so a hot-type message whose claimed
@@ -116,6 +120,20 @@ class Cluster:
         self.replicas = [
             Replica(config, i, seeds[i], **_app_kw()) for i in range(config.n)
         ]
+        # Durable-recovery model (ISSUE 15): with wal=True each replica
+        # gets an in-memory WriteAheadLog — the OBJECT plays the disk
+        # (it survives a simulated crash while the Replica object is
+        # discarded by restart()). restart_votes snapshots each
+        # restarted replica's pre-crash persisted votes for the S5
+        # checker; restart_epochs lets the checker re-baseline its
+        # executed/committed monotonicity tracking across a restart.
+        self.wals: Dict[int, WriteAheadLog] = {}
+        self.restart_votes: Dict[int, Dict] = {}
+        self.restart_epochs: Dict[int, int] = {}
+        if wal:
+            for r in self.replicas:
+                self.wals[r.id] = WriteAheadLog()
+                r.wal = self.wals[r.id]
         # Inbox entries carry the TRUE link-level sender (src, message):
         # the mac mode's authenticity model needs it, and the byte-
         # faithful round trip still runs in _route.
@@ -464,6 +482,52 @@ class Cluster:
     def uncrash(self, replica_id: int) -> None:
         """Recover a crashed replica (state intact, inbox empty — it must
         catch up via checkpoints/state transfer like a real restart)."""
+        self.crashed.discard(replica_id)
+
+    def restart(self, replica_id: int, from_disk: bool = True) -> None:
+        """Crash-restart realism (ISSUE 15): unlike ``uncrash`` (which
+        models a paused process resuming with its memory intact), this
+        discards the Replica OBJECT — the process died — and constructs
+        a fresh one: ``from_disk=True`` replays its write-ahead log
+        (requires wal=True at construction), re-joining the SAME view at
+        its stable-checkpoint floor with the no-contradiction guards
+        armed; ``from_disk=False`` is the amnesiac restart (fresh state
+        AND a blank wal) every pre-ISSUE-15 recovery story assumed.
+        Either way the pre-crash persisted votes are snapshotted into
+        ``restart_votes`` so the S5 checker can prove (or catch) the
+        no-double-vote property on everything sent afterwards."""
+        old = self.replicas[replica_id]
+        wal = self.wals.get(replica_id)
+        if wal is not None:
+            self.restart_votes.setdefault(replica_id, {}).update(
+                wal.state.votes
+            )
+        if self._app_factory is not None:
+            app_kw = {"app": self._app_factory()}
+        elif self._app is not None:
+            app_kw = {"app": self._app}
+        else:
+            app_kw = {}
+        fresh = Replica(
+            self.config, replica_id, self.seeds[replica_id], **app_kw
+        )
+        # The observability hooks belong to the "host", not the process:
+        # they survive the restart (chaos_soak's flight recorders).
+        fresh.phase_hook = old.phase_hook
+        fresh.view_hook = old.view_hook
+        fresh.batch_hook = old.batch_hook
+        if wal is not None:
+            if from_disk:
+                fresh.wal = wal
+                fresh.restore_from_wal(wal.state)
+            else:
+                self.wals[replica_id] = WriteAheadLog()  # blank disk
+                fresh.wal = self.wals[replica_id]
+        self.replicas[replica_id] = fresh
+        self.inboxes[replica_id] = []
+        self.restart_epochs[replica_id] = (
+            self.restart_epochs.get(replica_id, 0) + 1
+        )
         self.crashed.discard(replica_id)
 
     def trigger_view_change(self, replica_ids=None, new_view=None) -> None:

@@ -26,7 +26,8 @@ _lib: Optional[ctypes.CDLL] = None
 # link against the shared library.
 _LIB_SOURCES = [
     "blake2b.cc", "sha512.cc", "ed25519.cc", "json.cc", "messages.cc",
-    "metrics.cc", "flight.cc", "replica.cc", "verifier.cc", "verify_pool.cc",
+    "metrics.cc", "flight.cc", "wal.cc", "replica.cc", "verifier.cc",
+    "verify_pool.cc",
     "secure.cc", "net.cc", "net_shard.cc", "discovery.cc", "capi.cc",
 ]
 

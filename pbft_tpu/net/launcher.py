@@ -74,6 +74,8 @@ class LocalCluster:
         net_threads: int = 1,
         fastpath: str = "sig",
         tentative: bool = False,
+        wal: bool = False,
+        wal_fsync: bool = True,
     ):
         self.trace_dir = trace_dir
         # Black-box flight recorders (ISSUE 9): each daemon dumps its last
@@ -108,6 +110,14 @@ class LocalCluster:
         # EVERY replica (per-replica seeds derive from chaos_seed + id so
         # one scalar still gives each daemon its own stream).
         self.faults = dict(faults or {})
+        # Durable recovery (ISSUE 15): wal=True gives every replica a
+        # write-ahead log under {tmpdir}/wal (--wal-dir on both
+        # runtimes); kill(hard=True) + revive(from_disk=True) then
+        # exercises the kill -9 -> replay-from-disk path. wal_fsync=False
+        # keeps the writes but skips the fsync (the A/B durability-cost
+        # lever).
+        self.wal = wal
+        self.wal_fsync = wal_fsync
         self.chaos_drop_pct = chaos_drop_pct
         self.chaos_delay_ms = chaos_delay_ms
         self.chaos_seed = chaos_seed
@@ -147,6 +157,10 @@ class LocalCluster:
                 # both runtimes from network.json.
                 fastpath=fastpath,
                 tentative=tentative,
+                # Durable recovery (ISSUE 15): wal_fsync rides in
+                # network.json; the directory itself is a per-launch
+                # --wal-dir flag (set in __enter__, where tmpdir exists).
+                wal_fsync=wal_fsync,
             )
         self.config = config
         self.seeds = seeds
@@ -222,6 +236,10 @@ class LocalCluster:
                     "--flight-file",
                     str(Path(self.flight_dir) / f"replica-{i}.flight"),
                 ]
+            if self.wal:
+                wal_dir = Path(self.tmpdir.name) / "wal"
+                wal_dir.mkdir(parents=True, exist_ok=True)
+                cmd += ["--wal-dir", str(wal_dir)]
             if i in self.byzantine:
                 cmd += ["--byzantine"]
             if self.faults.get(i):
@@ -302,9 +320,15 @@ class LocalCluster:
                 out.append(f"=== {p.name} ===\n{p.read_text(errors='replace')}")
         return "\n".join(out)
 
-    def kill(self, replica_id: int) -> None:
-        """Crash-stop one replica (fault injection: PBFT tolerates f)."""
-        self.procs[replica_id].terminate()
+    def kill(self, replica_id: int, hard: bool = False) -> None:
+        """Crash-stop one replica (fault injection: PBFT tolerates f).
+        ``hard=True`` sends SIGKILL (the kill -9 realism arm, ISSUE 15):
+        no signal handler runs — no flight dump, no final fsync beyond
+        what group commit already made durable."""
+        if hard:
+            self.procs[replica_id].kill()
+        else:
+            self.procs[replica_id].terminate()
         self.procs[replica_id].wait(timeout=5)
 
     _KEEP = object()  # revive() sentinel: carry the original launch flag
@@ -315,16 +339,50 @@ class LocalCluster:
         fault=_KEEP,
         chaos_drop_pct=_KEEP,
         chaos_delay_ms=_KEEP,
+        from_disk: bool = False,
     ) -> None:
-        """Restart a killed replica with FRESH state (recovery scenario:
-        it must catch up via checkpoints + state transfer, PBFT §5.3).
+        """Restart a killed replica.
 
-        By default the revived daemon CARRIES the fault/chaos flags of the
-        original launch, so kill -> revive composes with fault schedules
-        instead of silently swapping in a clean replica. Pass
+        The default is the historic FRESH-STATE restart: the daemon
+        forgets everything and catches up via checkpoints + state
+        transfer (PBFT §5.3). CAVEAT this default silently relies on —
+        and tests composing faults must respect — the <= f window: an
+        amnesiac restart has forgotten its PREPARE/COMMIT votes, so for
+        the duration of its catch-up it can (under adversarial message
+        timing) vote differently than its previous life and must be
+        budgeted as one of the f tolerable faults. It is safe in every
+        scenario that keeps total concurrent faults within f, which is
+        why it was acceptable so far — but it is NOT a durability story.
+
+        ``from_disk=True`` (ISSUE 15) is the durability story: the
+        daemon relaunches with its original ``--wal-dir`` (requires the
+        cluster to have been built with ``wal=True``), replays the
+        write-ahead log, re-joins the SAME view at its stable-checkpoint
+        floor, and refuses to emit any vote contradicting a persisted
+        one — a from-disk restart never spends fault budget.
+
+        Either way the revived daemon CARRIES the fault/chaos flags of
+        the original launch, so kill -> revive composes with fault
+        schedules instead of silently swapping in a clean replica. Pass
         ``fault=None`` / ``chaos_*=0`` to revive clean(er), or a new
         mode/value to change the behavior across the restart."""
         cmd, env = self._cmds[replica_id]
+        if from_disk:
+            if "--wal-dir" not in cmd:
+                raise ValueError(
+                    "revive(from_disk=True) needs a cluster launched with "
+                    "wal=True (no --wal-dir on the original command)"
+                )
+        elif "--wal-dir" in cmd:
+            # Fresh-state semantics must stay the default even on a
+            # wal-enabled cluster: wipe this replica's log so the replay
+            # finds nothing (the amnesia scenario, deliberately).
+            ix = cmd.index("--wal-dir")
+            wal_path = Path(cmd[ix + 1]) / f"replica-{replica_id}.wal"
+            try:
+                wal_path.unlink()
+            except FileNotFoundError:
+                pass
         if fault is not self._KEEP or chaos_drop_pct is not self._KEEP or (
             chaos_delay_ms is not self._KEEP
         ):

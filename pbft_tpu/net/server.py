@@ -259,10 +259,41 @@ class AsyncReplicaServer:
         chaos_seed: Optional[int] = None,
         metrics_port: Optional[int] = None,
         flight=None,
+        wal=None,
     ):
         self.config = config
         self.id = replica_id
         self.replica = Replica(config, replica_id, seed)
+        # Durable recovery (ISSUE 15, consensus/wal.py): attach the
+        # write-ahead log (opened/replayed by main() BEFORE the event
+        # loop — file I/O stays off the loop) and reinstall any
+        # persisted pre-crash state. The recovery span is stamped into
+        # the flight ring + the pbft_recovery_seconds gauge once the
+        # metrics registry exists (below).
+        self.wal = wal
+        self.recovered_from_wal = False
+        self._recovery_seconds = 0.0
+        self._seen_wal = (0, 0, 0)  # (appends, fsyncs, bytes) snapshots
+        if wal is not None:
+            self.replica.wal = wal
+            if not wal.recovered.empty():
+                if flight is not None:
+                    rec = wal.recovered
+                    flight.record(
+                        "recovery_started",
+                        view=rec.view,
+                        seq=rec.checkpoint[0] if rec.checkpoint else 0,
+                    )
+                t0 = time.monotonic()
+                self.replica.restore_from_wal(wal.recovered)
+                self._recovery_seconds = time.monotonic() - t0
+                self.recovered_from_wal = True
+                if flight is not None:
+                    flight.record(
+                        "recovery_complete",
+                        view=self.replica.view,
+                        seq=self.replica.executed_upto,
+                    )
         # Metrics + consensus-phase spans (utils/metrics.py; names are the
         # cross-runtime contract in utils/trace_schema.py). The registry is
         # live whenever a scrape surface was asked for; spans additionally
@@ -481,6 +512,11 @@ class AsyncReplicaServer:
             self.metrics_registry.counter(
                 "pbft_cross_thread_wakes_total"
             ).inc(0)
+            # Durable-recovery surface (ISSUE 15): how long the WAL
+            # replay + reinstall took (0 = this life started fresh).
+            self.metrics_registry.gauge("pbft_recovery_seconds").set(
+                round(self._recovery_seconds, 6)
+            )
         if self.discovery_target:
             from .discovery import Discovery
 
@@ -1089,7 +1125,27 @@ class AsyncReplicaServer:
                 reqs=[[r.client, r.timestamp] for r in pp.requests],
             )
 
+    def _flush_wal(self) -> None:
+        """Group commit (ISSUE 15): one write + one fsync for every WAL
+        record noted since the last emit boundary — durability BEFORE
+        visibility, off the per-message path. Sync on purpose: the send
+        tasks _emit creates only run after this method returns, so no
+        vote can reach a socket before it is durable."""
+        wal = self.wal
+        if wal is None or not wal.pending():
+            return
+        wal.flush()
+        if self.metrics_registry.enabled:
+            a0, f0, b0 = self._seen_wal
+            reg = self.metrics_registry
+            reg.counter("pbft_wal_appends_total").inc(wal.appends - a0)
+            reg.counter("pbft_wal_fsyncs_total").inc(wal.fsyncs - f0)
+            reg.counter("pbft_wal_bytes_total").inc(wal.bytes_written - b0)
+        self._seen_wal = (wal.appends, wal.fsyncs, wal.bytes_written)
+
     def _emit(self, actions: List) -> None:
+        if self.wal is not None:
+            self._flush_wal()
         loop = asyncio.get_running_loop()
         mute = self.fault == "mute"
         for act in actions:
@@ -1685,6 +1741,12 @@ class AsyncReplicaServer:
             "tentative": self.config.tentative,
             "mac_frames": self.mac_frames,
             "mac_rejected": self.mac_rejected,
+            # Durable-recovery surface (ISSUE 15).
+            "wal_enabled": self.wal is not None,
+            "recovered_from_wal": self.recovered_from_wal,
+            "wal_appends": self.wal.appends if self.wal else 0,
+            "wal_fsyncs": self.wal.fsyncs if self.wal else 0,
+            "wal_bytes": self.wal.bytes_written if self.wal else 0,
             "committed_upto": self.replica.committed_upto,
             "executed_upto": self.replica.executed_upto,
             "low_mark": self.replica.low_mark,
@@ -1694,10 +1756,11 @@ class AsyncReplicaServer:
         }
 
 
-async def _amain(args, config_text: str, flight=None) -> None:
+async def _amain(args, config_text: str, flight=None, wal=None) -> None:
     # config_text is read by main() BEFORE the event loop starts: file
     # I/O inside a coroutine is a blocking call on the loop (flagged by
-    # pbft_tpu/analysis/async_blocking.py, scripts/pbft_lint.py).
+    # pbft_tpu/analysis/async_blocking.py, scripts/pbft_lint.py). The
+    # WAL is opened/replayed there too (ISSUE 15) for the same reason.
     config = ClusterConfig.from_json(config_text)
     # --batch-* override network.json (ISSUE 4), mirroring pbftd.
     import dataclasses as _dc
@@ -1726,6 +1789,7 @@ async def _amain(args, config_text: str, flight=None) -> None:
         chaos_seed=args.chaos_seed,
         metrics_port=args.metrics_port,
         flight=flight,
+        wal=wal,
     )
     await server.start()
     print(
@@ -1780,6 +1844,24 @@ def main() -> None:
         help="execute + reply at PREPARED (tentative, ISSUE 14) with "
         "rollback on view change; clients need 2f+1 matching tentative "
         "votes (overrides network.json tentative=false)",
+    )
+    parser.add_argument(
+        "--wal-dir",
+        default="",
+        help="durable recovery (ISSUE 15): keep a write-ahead log at "
+        "{dir}/replica-{id}.wal (view, sent votes, stable checkpoint) "
+        "with group-commit fsync, and on restart replay it so this "
+        "replica re-joins the SAME view without contradicting a "
+        "persisted vote (overrides network.json wal_dir)",
+    )
+    parser.add_argument(
+        "--wal-fsync",
+        type=int,
+        default=-1,
+        choices=(-1, 0, 1),
+        help="1/0 overrides network.json wal_fsync: 0 keeps the WAL "
+        "writes but skips fsync (kill -9 of the process stays safe via "
+        "the page cache; only host power loss can drop the tail)",
     )
     parser.add_argument(
         "--metrics-port",
@@ -1849,8 +1931,26 @@ def main() -> None:
         install_signal_dump(flight, args.flight_file)
     with open(args.config) as fh:
         config_text = fh.read()
+    # Durable recovery (ISSUE 15): open + replay the WAL here, before
+    # the event loop exists — replay is file I/O, and the no-blocking-
+    # calls-on-the-loop lint applies to it like any other read.
+    wal = None
+    cfg_for_wal = ClusterConfig.from_json(config_text)
+    wal_dir = args.wal_dir or cfg_for_wal.wal_dir
+    if wal_dir:
+        import os as _os
+
+        from ..consensus.wal import WriteAheadLog
+
+        _os.makedirs(wal_dir, exist_ok=True)
+        do_fsync = (
+            cfg_for_wal.wal_fsync if args.wal_fsync < 0 else bool(args.wal_fsync)
+        )
+        wal = WriteAheadLog(
+            _os.path.join(wal_dir, f"replica-{args.id}.wal"), fsync=do_fsync
+        )
     try:
-        asyncio.run(_amain(args, config_text, flight=flight))
+        asyncio.run(_amain(args, config_text, flight=flight, wal=wal))
     except BaseException:
         # Fatal path (unhandled exception, loop torn down): the black box
         # must still ship — same contract as pbftd's on_fatal handler.

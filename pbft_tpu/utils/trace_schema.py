@@ -256,6 +256,17 @@ METRIC_SCHEMAS = {
     "pbft_mac_frames_total": ("counter", {"server.py", "net.cc"}),
     "pbft_tentative_executions_total": ("counter", {"server.py", "net.cc"}),
     "pbft_tentative_rollbacks_total": ("counter", {"server.py", "net.cc"}),
+    # Durable-recovery surface (ISSUE 15). WAL appends: records written
+    # to the write-ahead log (votes, view transitions, stable
+    # checkpoints); fsyncs: group-commit fsync syscalls (one per emit
+    # boundary with pending records — NOT one per message; zero with
+    # wal_fsync off); bytes: file bytes written (appends + compactions).
+    # Recovery seconds: wall time of the last WAL replay + state
+    # reinstall (gauge; 0 = this life started fresh).
+    "pbft_wal_appends_total": ("counter", {"server.py", "net.cc"}),
+    "pbft_wal_fsyncs_total": ("counter", {"server.py", "net.cc"}),
+    "pbft_wal_bytes_total": ("counter", {"server.py", "net.cc"}),
+    "pbft_recovery_seconds": ("gauge", {"server.py", "net.cc"}),
     "pbft_batch_size": ("histogram", {"server.py", "net.cc"}),
     "pbft_verify_batch_size": ("histogram", {"server.py", "service.py", "net.cc"}),
     "pbft_verify_seconds": ("histogram", {"server.py", "service.py", "net.cc"}),
@@ -319,6 +330,11 @@ FLIGHT_EVENTS = {
     # / certified-checkpoint catch-up (seq = sequences rolled back).
     15: "tentative_reply",
     16: "tentative_rollback",
+    # Durable recovery (ISSUE 15): WAL replay began (view = persisted
+    # view, seq = the stable-checkpoint floor) and recovery finished
+    # (seq = the recovered executed_upto). core/flight.h mirrors the ids.
+    17: "recovery_started",
+    18: "recovery_complete",
 }
 FLIGHT_EVENT_IDS = {name: i for i, name in FLIGHT_EVENTS.items()}
 

@@ -55,6 +55,13 @@ ARMS = (
     "stutter-primary",
     "link-drop",
     "gateway-kill",
+    # Durable recovery (ISSUE 15): SIGKILL a backup mid-firehose (no
+    # signal handler runs — only what group commit made durable
+    # survives), then restart it with --wal-dir: it must replay the log,
+    # re-join the SAME view without contradicting a persisted vote, and
+    # catch the suffix up via state transfer. The arm reports
+    # recovery_after_restart_s and pins recovered_from_wal.
+    "kill9-restart",
 )
 
 # Completion bar per arm: the crash/HA arms must stay lossless (that is
@@ -64,6 +71,7 @@ COMPLETION_BAR = {
     "fault-free": 100.0,
     "crash-backup": 100.0,
     "gateway-kill": 100.0,
+    "kill9-restart": 100.0,
     "stutter-primary": 97.0,
     "link-drop": 97.0,
 }
@@ -395,7 +403,57 @@ class FaultSchedule(threading.Thread):
         n = self.cluster.config.n
         victim = n - 1  # a BACKUP in view 0 (primary is 0)
         time.sleep(self.fault_at_s)
-        if self.arm == "crash-backup":
+        if self.arm == "kill9-restart":
+            # Durable recovery (ISSUE 15): SIGKILL — no handler, no
+            # flight dump, nothing beyond what group commit already made
+            # durable — then restart FROM DISK. Catch-up is proven the
+            # same way as crash-backup, plus the recovered_from_wal pin.
+            self.cluster.kill(victim, hard=True)
+            self.result["killed_replica"] = victim
+            time.sleep(max(0.0, self.heal_at_s - self.fault_at_s))
+            log = Path(self.cluster.tmpdir.name) / f"replica-{victim}.log"
+            pre_lines = len(
+                re.findall(
+                    r'"executed_upto"', log.read_text(errors="replace")
+                )
+            )
+            t_heal = time.monotonic()
+            self.cluster.revive(victim, from_disk=True)
+            interval = self.cluster.config.checkpoint_interval
+            deadline = t_heal + 60.0
+            while time.monotonic() < deadline:
+                text = log.read_text(errors="replace")
+                hits = re.findall(r'"executed_upto":\s*(-?\d+)', text)
+                mine = int(hits[-1]) if len(hits) > pre_lines else None
+                best = max(
+                    (
+                        _last_metric(self.cluster, r, "executed_upto") or 0
+                        for r in range(n)
+                        if r != victim
+                    ),
+                    default=0,
+                )
+                if mine is not None and mine >= best - interval:
+                    self.result["recovery_after_restart_s"] = round(
+                        time.monotonic() - t_heal, 3
+                    )
+                    self.result["recovered_from_wal"] = (
+                        '"recovered_from_wal":true' in text
+                    )
+                    return
+                time.sleep(0.25)
+            # Never converged within the deadline. NOTE the restart must
+            # land while the firehose still runs: catch-up past the
+            # recovered checkpoint floor rides peer checkpoints -> state
+            # transfer, and an idle cluster produces neither (the victim
+            # stays consistently AT its floor until traffic resumes —
+            # schedule heal_at_s inside the load window).
+            self.result["recovery_after_restart_s"] = -1.0
+            self.result["recovered_from_wal"] = (
+                '"recovered_from_wal":true'
+                in log.read_text(errors="replace")
+            )
+        elif self.arm == "crash-backup":
             self.cluster.kill(victim)
             self.result["killed_replica"] = victim
             time.sleep(max(0.0, self.heal_at_s - self.fault_at_s))
@@ -491,6 +549,9 @@ def run_arm_traced(
             admission_backlog=admission_backlog,
             fastpath=mode,
             tentative=(mode == "mac"),
+            # The kill9 arm needs the durability layer live on every
+            # replica (ISSUE 15): the victim restarts from its WAL.
+            wal=(arm == "kill9-restart"),
             faults=faults,
             chaos_drop_pct=drop,
             chaos_seed=seed if drop > 0 else None,

@@ -114,6 +114,7 @@ def run_one(
     verbose: bool = False,
     flight_dir: Optional[str] = None,
     mode: str = "sig",
+    crash_restart: bool = False,
 ) -> dict:
     """One soak run. Returns {ok, seed, n, violation?, schedule, ...}.
 
@@ -123,7 +124,13 @@ def run_one(
     the S1-S3/L1 matrix covers the authenticator+tentative protocol. A
     deterministic mid-run view change (below) guarantees every seed
     exercises a view change while tentative executions are in flight —
-    the rollback path is load-bearing, not incidental."""
+    the rollback path is load-bearing, not incidental.
+
+    ``crash_restart`` (ISSUE 15): every replica gets a write-ahead log
+    and every crash recovery becomes a PROCESS RESTART that replays it —
+    the S5 invariant (a restarted replica's post-recovery sends never
+    contradict its persisted pre-crash votes) is then live alongside
+    S1-S3/L1."""
     import dataclasses as _dc
 
     from pbft_tpu.consensus.config import make_local_cluster
@@ -132,11 +139,13 @@ def run_one(
     if mode == "mac":
         config = _dc.replace(config, fastpath="mac", tentative=True)
     cluster = Cluster(config=config, seeds=seeds, seed=seed, shuffle=True,
-                      verifier=_pick_verifier(), app=_echo_app, mode=mode)
+                      verifier=_pick_verifier(), app=_echo_app, mode=mode,
+                      wal=crash_restart)
     recorders = _wire_flight(cluster) if flight_dir else {}
     checker = InvariantChecker(cluster)
     if schedule is None:
-        schedule = random_schedule(seed, n, steps)
+        schedule = random_schedule(seed, n, steps,
+                                   restart_from_disk=crash_restart)
     schedule.reset()
     clients = [f"10.0.0.{k}:9000" for k in range(1, 4)]
     submitted = []
@@ -365,6 +374,12 @@ def main(argv=None) -> int:
                         "sig = signature-verified hot path, mac = "
                         "authenticator acceptance + tentative execution "
                         "with a forced mid-run view change (default both)")
+    parser.add_argument(
+        "--crash-restart", action="store_true",
+        help="durable-recovery matrix (ISSUE 15): give every replica a "
+        "write-ahead log and turn every crash recovery into a process "
+        "RESTART that replays it — the S5 no-double-vote invariant runs "
+        "alongside S1-S3/L1")
     parser.add_argument("--replay", type=int, default=None,
                         help="re-run ONE seed verbosely (deterministic)")
     parser.add_argument("--validate", action="store_true",
@@ -397,7 +412,8 @@ def main(argv=None) -> int:
                       f"steps={args.steps}:")
                 res = run_one(args.replay, n, args.steps,
                               submit_every=args.submit_every, verbose=True,
-                              flight_dir=args.flight_dir or None, mode=mode)
+                              flight_dir=args.flight_dir or None, mode=mode,
+                              crash_restart=args.crash_restart)
                 if res["ok"]:
                     print(f"  OK: {res['submitted']} requests, "
                           f"executed up to {res['executed']}, "
@@ -416,7 +432,8 @@ def main(argv=None) -> int:
             for n in sizes:
                 res = run_one(seed, n, args.steps,
                               submit_every=args.submit_every,
-                              flight_dir=args.flight_dir or None, mode=mode)
+                              flight_dir=args.flight_dir or None, mode=mode,
+                              crash_restart=args.crash_restart)
                 if res["ok"]:
                     print(f"seed {seed:>3} n={n} mode={mode}: OK  "
                           f"({res['submitted']} reqs, "

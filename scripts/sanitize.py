@@ -47,7 +47,8 @@ BUILD_ROOT = REPO / "build-core-san"
 # Library sources (core/CMakeLists.txt order) + the two test binaries.
 LIB_SOURCES = [
     "blake2b.cc", "sha512.cc", "ed25519.cc", "json.cc", "messages.cc",
-    "metrics.cc", "flight.cc", "replica.cc", "verifier.cc", "verify_pool.cc",
+    "metrics.cc", "flight.cc", "wal.cc", "replica.cc", "verifier.cc",
+    "verify_pool.cc",
     "secure.cc", "net.cc", "net_shard.cc", "discovery.cc",
 ]
 BINARIES = {

@@ -234,6 +234,7 @@ def run_point(
     deadline_s: float,
     net_threads: int = 1,
     mode: str = "sig",
+    wal: str = "off",
 ) -> dict:
     """One sustained point on the curve: an n-replica cluster, a gateway
     tier in front, ``clients`` concurrent identities through it.
@@ -257,6 +258,12 @@ def run_point(
         net_threads=net_threads,
         fastpath=mode,
         tentative=(mode == "mac"),
+        # Durability arms (ISSUE 15): "on" = WAL + group-commit fsync
+        # (gates against the historic key — durability must stay off
+        # the per-message path), "nofsync" = WAL writes without fsync
+        # (the A/B that makes the fsync cost explicit).
+        wal=(wal != "off"),
+        wal_fsync=(wal != "nofsync"),
     ) as cluster:
         cfg_path = Path(cluster.tmpdir.name) / "network.json"
         gws = []
@@ -327,9 +334,16 @@ def run_point(
         config_key += f" t{net_threads}"
     if mode != "sig":
         config_key += f" {mode}"
+    # WAL arms (ISSUE 15): "on" keeps the historic key — the acceptance
+    # gate is precisely that group-commit durability does NOT regress the
+    # fault-free firehose vs the last pre-WAL run; "nofsync" is its own
+    # group so the fsync cost reads directly off the two rows.
+    if wal == "nofsync":
+        config_key += " wal-nofsync"
     return {
         "config": config_key,
         "mode": mode,
+        "wal": wal,
         "replicas": n,
         "f": (n - 1) // 3,
         "clients": clients,
@@ -389,6 +403,14 @@ def main() -> int:
         "2f+1 tentative reply quorum). Rides into the JSONL config "
         "field for bench_compare --group-by.",
     )
+    parser.add_argument(
+        "--wal", default="off", choices=("off", "on", "nofsync"),
+        help="durability arm (ISSUE 15): on = write-ahead log with "
+        "group-commit fsync (keeps the historic config key — the gate "
+        "that durability stays off the per-message path); nofsync = WAL "
+        "writes without fsync (own config group: the explicit fsync "
+        "cost)",
+    )
     parser.add_argument("--deadline-s", type=float, default=600.0,
                         help="hard per-point wall-clock bound")
     parser.add_argument("--out", default=None, help="append JSONL here")
@@ -403,6 +425,7 @@ def main() -> int:
                 n, args.clients, args.requests, args.window, args.batch,
                 args.batch_flush_us, args.impl, args.gateways,
                 args.deadline_s, net_threads=args.net_threads, mode=mode,
+                wal=args.wal,
             )
             print(json.dumps(row), flush=True)
             rows.append(row)

@@ -164,6 +164,38 @@ def test_divergent_config_default_trips(tmp_path):
     assert any("watermark_window" in e for e in errors), errors
 
 
+def test_divergent_wal_constants_trip(tmp_path):
+    """ISSUE 15 pairs: a drifted WAL magic, record tag, or wal_fsync
+    config default each fails the build — the on-disk format is the
+    cross-runtime recovery contract (a pbftd-written log must replay in
+    the Python tooling byte-for-byte, and a sparse network.json must
+    mean fsync-on in both runtimes)."""
+    root = _shadow_tree(tmp_path)
+    w = root / "pbft_tpu" / "consensus" / "wal.py"
+    text = w.read_text()
+    assert 'WAL_MAGIC = b"PBFTWAL1"' in text
+    w.write_text(text.replace(
+        'WAL_MAGIC = b"PBFTWAL1"', 'WAL_MAGIC = b"PBFTWAL2"'))
+    errors = constants.check(root)
+    assert any("WAL file magic" in e for e in errors), errors
+
+    root2 = _shadow_tree(tmp_path / "b")
+    hdr = root2 / "core" / "wal.h"
+    hdr.write_text(hdr.read_text().replace(
+        "kWalRecCheckpoint = 0x03", "kWalRecCheckpoint = 0x04"))
+    errors = constants.check(root2)
+    assert any("WAL record tag: checkpoint" in e for e in errors), errors
+
+    root3 = _shadow_tree(tmp_path / "c")
+    cfg = root3 / "pbft_tpu" / "consensus" / "config.py"
+    cfg.write_text(cfg.read_text().replace(
+        "wal_fsync: bool = True", "wal_fsync: bool = False"))
+    errors = constants.check(root3)
+    assert any(
+        "ClusterConfig default: wal_fsync" in e for e in errors
+    ), errors
+
+
 def test_blocking_call_in_async_trips(tmp_path):
     root = _shadow_tree(tmp_path)
     fixture = root / "pbft_tpu" / "net" / "fixture_blocking.py"
