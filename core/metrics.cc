@@ -82,6 +82,14 @@ const char* kGaugeNames[] = {
     // Durable-recovery surface (ISSUE 15): wall seconds the last WAL
     // replay + state reinstall took (0 = no recovery this life).
     "pbft_recovery_seconds",
+    // Health-introspection surface (ISSUE 16): resident set, open fds,
+    // WAL on-disk bytes, seconds since executed_upto last advanced, and
+    // the verify-inbox depth — refreshed lazily at scrape/status time.
+    "pbft_process_rss_bytes",
+    "pbft_open_fds",
+    "pbft_wal_disk_bytes",
+    "pbft_last_progress_seconds",
+    "pbft_inbox_depth",
 };
 // name -> uses the size bucket ladder (else latency).
 const std::pair<const char*, bool> kHistogramNames[] = {

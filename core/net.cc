@@ -1,6 +1,7 @@
 #include "net.h"
 
 #include <arpa/inet.h>
+#include <dirent.h>
 #include <fcntl.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
@@ -1382,18 +1383,36 @@ void ReplicaServer::serve_metrics_ready() {
     int fd = accept(metrics_listen_fd_, nullptr, nullptr);
     if (fd < 0) return;
     tune_stream_socket(fd);
-    // One-shot scrape: the request bytes are irrelevant (any GET gets the
-    // full exposition), so drain best-effort, answer, close. The body is
-    // a few KB — one blocking send fits the socket buffer.
+    // One-shot scrape, routed on the request line: "/status" gets the
+    // health document (metrics_json) as JSON, anything else the full
+    // Prometheus exposition. The request bytes may trail the accept, so
+    // wait briefly (bounded — a poller pass must not hang on a client
+    // that connects and says nothing); an empty read scrapes Prometheus.
     char sink[1024];
-    (void)recv(fd, sink, sizeof(sink), MSG_DONTWAIT);
-    std::string body = metrics_prometheus();
+    struct timeval rcv_to{0, 250000};
+    setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &rcv_to, sizeof(rcv_to));
+    ssize_t got = recv(fd, sink, sizeof(sink) - 1, 0);
+    bool want_status = false;
+    if (got > 0) {
+      sink[got] = '\0';
+      want_status = std::strstr(sink, " /status") != nullptr;
+    }
+    refresh_health();
+    std::string body;
+    const char* content_type;
+    if (want_status) {
+      body = metrics_json();
+      content_type = "application/json";
+    } else {
+      body = metrics_prometheus();
+      content_type = "text/plain; version=0.0.4";
+    }
     char hdr[160];
     int hn = std::snprintf(hdr, sizeof(hdr),
                            "HTTP/1.0 200 OK\r\n"
-                           "Content-Type: text/plain; version=0.0.4\r\n"
+                           "Content-Type: %s\r\n"
                            "Content-Length: %zu\r\n\r\n",
-                           body.size());
+                           content_type, body.size());
     std::string resp(hdr, (size_t)hn);
     resp += body;
     (void)send(fd, resp.data(), resp.size(), MSG_NOSIGNAL);
@@ -1669,6 +1688,7 @@ bool ReplicaServer::enable_wal(const std::string& dir) {
     wal_.reset();
     return false;
   }
+  wal_path_ = path;  // stat target for pbft_wal_disk_bytes
   replica_->set_wal(wal_.get());
   const WalState& rec = wal_->recovered();
   if (!rec.empty()) {
@@ -2367,7 +2387,62 @@ void ReplicaServer::pump_reply_backlog() {
   reply_backlog_ = std::move(keep);
 }
 
-std::string ReplicaServer::metrics_json() const {
+namespace {
+
+// Resident set in bytes from /proc/self/statm field 2 (pages). Returns 0
+// where /proc is absent — the detectors treat a zero reading as "no
+// data", never as a leak baseline.
+int64_t read_rss_bytes() {
+  FILE* f = std::fopen("/proc/self/statm", "r");
+  if (!f) return 0;
+  long long vm_pages = 0, rss_pages = 0;
+  int got = std::fscanf(f, "%lld %lld", &vm_pages, &rss_pages);
+  std::fclose(f);
+  if (got != 2) return 0;
+  return (int64_t)rss_pages * (int64_t)sysconf(_SC_PAGESIZE);
+}
+
+// Open file descriptors via /proc/self/fd (the dirfd the walk itself
+// holds is excluded). Returns 0 where /proc is absent.
+int64_t count_open_fds() {
+  DIR* d = opendir("/proc/self/fd");
+  if (!d) return 0;
+  int64_t n = 0;
+  while (struct dirent* e = readdir(d)) {
+    if (e->d_name[0] != '.') ++n;
+  }
+  closedir(d);
+  return n > 0 ? n - 1 : 0;  // minus the opendir fd
+}
+
+int64_t file_size_bytes(const std::string& path) {
+  if (path.empty()) return 0;
+  struct stat st{};
+  return ::stat(path.c_str(), &st) == 0 ? (int64_t)st.st_size : 0;
+}
+
+}  // namespace
+
+void ReplicaServer::refresh_health() {
+  const auto now = std::chrono::steady_clock::now();
+  const int64_t executed = replica_->executed_upto();
+  if (executed != progress_seen_executed_) {
+    progress_seen_executed_ = executed;
+    progress_seen_at_ = now;
+  }
+  if (!metrics_.enabled) return;
+  const double since =
+      std::chrono::duration<double>(now - progress_seen_at_).count();
+  metrics_.set_gauge("pbft_process_rss_bytes", (double)read_rss_bytes());
+  metrics_.set_gauge("pbft_open_fds", (double)count_open_fds());
+  metrics_.set_gauge("pbft_wal_disk_bytes",
+                     (double)file_size_bytes(wal_path_));
+  metrics_.set_gauge("pbft_last_progress_seconds", since);
+  metrics_.set_gauge("pbft_inbox_depth", (double)replica_->pending_count());
+}
+
+std::string ReplicaServer::metrics_json() {
+  refresh_health();
   JsonObject o;
   o["replica"] = Json(id_);
   o["port"] = Json(listen_port_);
@@ -2434,6 +2509,24 @@ std::string ReplicaServer::metrics_json() const {
   o["low_mark"] = Json(replica_->low_mark());
   o["view"] = Json(replica_->view());
   o["in_view_change"] = Json(replica_->in_view_change());
+  // Health document (ISSUE 16; shape contracted with server.py by
+  // kHealthDocVersion): resource readings, progress watermarks, and the
+  // identity digests the divergence detector compares. The progress
+  // clock is quantized to the refresh cadence (see refresh_health).
+  const auto now = std::chrono::steady_clock::now();
+  o["health_version"] = Json(kHealthDocVersion);
+  o["uptime_seconds"] =
+      Json(std::chrono::duration<double>(now - start_time_).count());
+  o["rss_bytes"] = Json(read_rss_bytes());
+  o["open_fds"] = Json(count_open_fds());
+  o["wal_disk_bytes"] = Json(file_size_bytes(wal_path_));
+  o["inbox_depth"] = Json((int64_t)replica_->pending_count());
+  o["sealed_unexecuted"] = Json(replica_->seal_backlog());
+  o["waiting_requests"] = Json((int64_t)waiting_requests_.size());
+  o["last_progress_seconds"] =
+      Json(std::chrono::duration<double>(now - progress_seen_at_).count());
+  o["chain_digest"] = Json(replica_->committed_chain_hex());
+  o["state_digest"] = Json(replica_->state_digest_hex());
   for (const auto& [k, v] : replica_->counters) o[k] = Json(v);
   return Json(o).dump();
 }

@@ -71,6 +71,18 @@ void tune_listen_socket(int fd);
 // retransmission path, not dialed.
 inline constexpr const char* kGatewayClientPrefix = "gw/";
 
+// Health-introspection contract (ISSUE 16; Python mirrors in
+// pbft_tpu/utils/trace_schema.py + pbft_tpu/analysis/health.py,
+// constants lint pairs). kHealthDocVersion stamps the metrics_json
+// status surface so pbft_top / the detector library can refuse
+// snapshots from a runtime speaking a different document shape.
+// kHealthStallSeconds is the silent-stall threshold: pending work with
+// executed_upto flat this long trips the detector. kHealthSnapshotIntervalS
+// is the default poll cadence for pbft_top / endurance_soak snapshots.
+inline constexpr int kHealthDocVersion = 1;
+inline constexpr int kHealthStallSeconds = 5;
+inline constexpr int kHealthSnapshotIntervalS = 2;
+
 // 4-byte big-endian length prefix + payload (the framed wire format).
 // Shared by the single-threaded loop and the shard/pipeline tier.
 std::string frame_payload(const std::string& payload);
@@ -331,8 +343,13 @@ class ReplicaServer {
   // Which readiness backend this server runs on ("epoll-et" or "poll") —
   // the epoll-vs-poll parity arm in core_test asserts both paths.
   const char* net_backend() const;
-  // One JSON metrics line (counters + queue depths).
-  std::string metrics_json() const;
+  // One JSON metrics line (counters + queue depths), extended into the
+  // versioned health document (ISSUE 16): health_version, uptime,
+  // RSS/fd/WAL-bytes resource readings, progress watermarks and chain/
+  // state digests. Non-const: rendering refreshes the last-progress
+  // tracker and the health gauges (lazy — an unscraped replica pays
+  // nothing for them).
+  std::string metrics_json();
 
   // Prometheus scrape surface (metric names contracted with the Python
   // runtime by pbft_tpu/utils/trace_schema.py): call before start() to
@@ -527,6 +544,7 @@ class ReplicaServer {
   // of a pass's votes reach a socket) and once per poll pass; the
   // counters below are last-seen snapshots for the metric deltas.
   std::unique_ptr<Wal> wal_;
+  std::string wal_path_;  // on-disk file (pbft_wal_disk_bytes stat target)
   bool recovered_from_wal_ = false;
   double recovery_seconds_ = 0.0;
   int64_t seen_wal_appends_ = 0;
@@ -552,8 +570,15 @@ class ReplicaServer {
   // transition; at "executed" observes the per-phase latency histograms
   // and emits one consensus_span trace event (utils/trace_schema.py).
   void on_phase(const char* phase, int64_t view, int64_t seq);
-  // Accept + answer /metrics scrapes (one-shot: write response, close).
+  // Accept + answer scrapes (one-shot: write response, close). Routes on
+  // the request line: "/status" serves metrics_json() as JSON, anything
+  // else the Prometheus text rendering.
   void serve_metrics_ready();
+  // Lazy health refresh (ISSUE 16): advance the last-progress tracker
+  // against replica_->executed_upto() and push the resource/progress
+  // health gauges into the registry. Called whenever the status surface
+  // renders (metrics_json / Prometheus scrape).
+  void refresh_health();
   // Abandon an over-deadline inflight async verify (see
   // set_verify_deadline_ms); no-op unless wedged.
   void check_verify_deadline(std::chrono::steady_clock::time_point now);
@@ -715,6 +740,16 @@ class ReplicaServer {
   std::chrono::steady_clock::time_point inflight_start_{};
   int verify_deadline_ms_ = 15000;
   int64_t verify_deadline_fired_ = 0;  // surfaced in metrics_json
+
+  // Health-document progress tracker (ISSUE 16): the executed_upto we
+  // last saw move and when we saw it. Updated by refresh_health(), so
+  // last_progress_seconds is quantized to the observation cadence — fine
+  // for a detector whose threshold is whole seconds.
+  std::chrono::steady_clock::time_point start_time_ =
+      std::chrono::steady_clock::now();
+  int64_t progress_seen_executed_ = -1;
+  std::chrono::steady_clock::time_point progress_seen_at_ =
+      std::chrono::steady_clock::now();
 
   // Metrics registry + scrape listener (enabled by set_metrics_port).
   Metrics metrics_;

@@ -217,6 +217,20 @@ PAIRS: List[Tuple[str, Tuple[str, str], Tuple[str, str]]] = [
     ("gateway route-cache bound",
      ("core/net.cc", "kMaxGatewayRoutes"),
      ("pbft_tpu/net/server.py", "MAX_GATEWAY_ROUTES")),
+    # ISSUE 16 health introspection: the health-document schema version
+    # both runtimes stamp into their /status surface, and the detector
+    # thresholds every gate (pbft_top, endurance_soak, chaos harnesses)
+    # shares — a fork here makes a mixed-runtime cluster's health reads
+    # incomparable.
+    ("health document version",
+     ("core/net.h", "kHealthDocVersion"),
+     ("pbft_tpu/utils/trace_schema.py", "HEALTH_DOC_VERSION")),
+    ("health stall threshold seconds",
+     ("core/net.h", "kHealthStallSeconds"),
+     ("pbft_tpu/analysis/health.py", "HEALTH_STALL_SECONDS")),
+    ("health snapshot interval seconds",
+     ("core/net.h", "kHealthSnapshotIntervalS"),
+     ("pbft_tpu/analysis/health.py", "HEALTH_SNAPSHOT_INTERVAL_S")),
 ]
 
 # Files consulted by extractors that are not simple name pairs.

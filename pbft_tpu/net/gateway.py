@@ -47,7 +47,13 @@ from typing import Dict, List, Optional
 
 from ..consensus.config import ClusterConfig
 from ..consensus.messages import ClientRequest
-from ..utils import MetricsRegistry, start_metrics_server
+from ..utils import (
+    MetricsRegistry,
+    count_open_fds,
+    read_rss_bytes,
+    start_metrics_server,
+)
+from ..utils.trace_schema import HEALTH_DOC_VERSION
 from . import secure
 from .client import PbftClient
 
@@ -167,6 +173,8 @@ class ClientGateway:
         # boxes the same way replica recorders do. None = one attribute
         # check per event site.
         self.flight = flight
+        # Health-document uptime anchor (ISSUE 16).
+        self._start_time = time.monotonic()
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -176,8 +184,11 @@ class ClientGateway:
         )
         self.listen_port = self._server.sockets[0].getsockname()[1]
         if self.metrics_port is not None:
+            # /status serves the gateway's health document (ISSUE 16) so
+            # pbft_top can watch the tier alongside the replicas.
             self._metrics_server = start_metrics_server(
-                self.metrics_registry, self.metrics_port
+                self.metrics_registry, self.metrics_port,
+                status_fn=self.metrics,
             )
             self.metrics_listen_port = self._metrics_server.server_address[1]
         # EVERY replica needs a live gateway link, not just the ones
@@ -216,6 +227,13 @@ class ClientGateway:
 
     def metrics(self) -> dict:
         return {
+            # Health document (ISSUE 16): the gateway is a replica-less
+            # process, so its document is the resource subset — no
+            # progress watermarks or chain digests to report.
+            "health_version": HEALTH_DOC_VERSION,
+            "uptime_seconds": round(time.monotonic() - self._start_time, 6),
+            "rss_bytes": read_rss_bytes(),
+            "open_fds": count_open_fds(),
             "gateway_clients_open": self.clients_open,
             "gateway_forwarded": self.forwarded,
             "replies_routed": self.replies_routed,
@@ -757,6 +775,9 @@ async def _amain(args, config_text: str, flight=None) -> None:
     )
     await gw.start()
     print(f"gateway listening on {gw.listen_port}", flush=True)
+    if gw.metrics_listen_port:
+        # pbft_top / endurance_soak parse this to find /status (ISSUE 16).
+        print(f"gateway metrics on {gw.metrics_listen_port}", flush=True)
     while True:
         await asyncio.sleep(args.metrics_every or 3600)
         if args.metrics_every:

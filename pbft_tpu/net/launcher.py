@@ -76,6 +76,7 @@ class LocalCluster:
         tentative: bool = False,
         wal: bool = False,
         wal_fsync: bool = True,
+        metrics_ports: bool = False,
     ):
         self.trace_dir = trace_dir
         # Black-box flight recorders (ISSUE 9): each daemon dumps its last
@@ -118,6 +119,14 @@ class LocalCluster:
         # lever).
         self.wal = wal
         self.wal_fsync = wal_fsync
+        # Health introspection (ISSUE 16): metrics_ports=True gives every
+        # replica a loopback scrape listener (--metrics-port, both
+        # runtimes) serving Prometheus + the /status health document;
+        # self.metrics_ports maps replica id -> bound port after
+        # __enter__ (pre-allocated — pbftd logs its ephemeral port to
+        # stderr, but pre-allocation keeps revive() on the same port).
+        self.want_metrics_ports = metrics_ports
+        self.metrics_ports: List[int] = []
         self.chaos_drop_pct = chaos_drop_pct
         self.chaos_delay_ms = chaos_delay_ms
         self.chaos_seed = chaos_seed
@@ -219,6 +228,10 @@ class LocalCluster:
             ]
             if self.metrics_every:
                 cmd += ["--metrics-every", str(self.metrics_every)]
+            if self.want_metrics_ports:
+                if not self.metrics_ports:
+                    self.metrics_ports = free_ports(self.config.n)
+                cmd += ["--metrics-port", str(self.metrics_ports[i])]
             if not self._batch_scalar:
                 cmd += [
                     "--batch-max-items", str(self.batch_max_items[i]),

@@ -55,7 +55,16 @@ from ..consensus.replica import (
     _host_sign,
     host_batch_verify,
 )
-from ..utils import ConsensusSpans, MetricsRegistry, get_tracer, start_metrics_server
+from ..utils import (
+    ConsensusSpans,
+    MetricsRegistry,
+    count_open_fds,
+    file_size_bytes,
+    get_tracer,
+    read_rss_bytes,
+    start_metrics_server,
+)
+from ..utils.trace_schema import HEALTH_DOC_VERSION
 from . import secure
 from .gateway import GATEWAY_CLIENT_PREFIX
 
@@ -481,6 +490,13 @@ class AsyncReplicaServer:
         # Gateway-fabric accounting (ISSUE 12): live gateway links that
         # died (clients behind them must fail over to another gateway).
         self.gateway_failovers = 0
+        # Health-document progress tracker (ISSUE 16; mirrors
+        # core/net.cc refresh_health): the executed_upto we last saw
+        # move and when we saw it — last_progress_seconds is quantized
+        # to the refresh cadence (every metrics()/status render).
+        self._start_time = time.monotonic()
+        self._progress_seen_executed = -1
+        self._progress_seen_at = time.monotonic()
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -524,8 +540,13 @@ class AsyncReplicaServer:
                 self.discovery_target, self.id, self.listen_port, self.config.n
             ).start()
         if self.metrics_port is not None:
+            # /status serves the health document (ISSUE 16). metrics()
+            # runs on the scrape thread there: it only reads GIL-atomic
+            # runtime state (ints, preset-key dicts) — same contract as
+            # the registry reads the Prometheus path does.
             self._metrics_server = start_metrics_server(
-                self.metrics_registry, self.metrics_port
+                self.metrics_registry, self.metrics_port,
+                status_fn=self.metrics,
             )
             self.metrics_listen_port = self._metrics_server.server_address[1]
         asyncio.get_running_loop().create_task(self._batch_pump())
@@ -1698,6 +1719,45 @@ class AsyncReplicaServer:
                 "backoff_level", view=self.replica.view, seq=level
             )
 
+    def _refresh_health(self) -> dict:
+        """Advance the last-progress tracker and push the health gauges
+        (ISSUE 16; mirrors core/net.cc refresh_health). Returns the
+        health-document fields metrics() folds into the status dict.
+        Lazy: runs only when the status surface renders, so an
+        unscraped replica pays nothing."""
+        now = time.monotonic()
+        executed = self.replica.executed_upto
+        if executed != self._progress_seen_executed:
+            self._progress_seen_executed = executed
+            self._progress_seen_at = now
+        rss = read_rss_bytes()
+        fds = count_open_fds()
+        wal_bytes = file_size_bytes(self.wal.path if self.wal else None)
+        inbox = self.replica.pending_count()
+        since = round(now - self._progress_seen_at, 6)
+        if self.metrics_registry.enabled:
+            reg = self.metrics_registry
+            reg.gauge("pbft_process_rss_bytes").set(rss)
+            reg.gauge("pbft_open_fds").set(fds)
+            reg.gauge("pbft_wal_disk_bytes").set(wal_bytes)
+            reg.gauge("pbft_last_progress_seconds").set(since)
+            reg.gauge("pbft_inbox_depth").set(inbox)
+        return {
+            "health_version": HEALTH_DOC_VERSION,
+            "uptime_seconds": round(now - self._start_time, 6),
+            "rss_bytes": rss,
+            "open_fds": fds,
+            "wal_disk_bytes": wal_bytes,
+            "inbox_depth": inbox,
+            "sealed_unexecuted": max(
+                0, self.replica.seq_counter - self.replica.executed_upto
+            ),
+            "waiting_requests": len(self._waiting_requests),
+            "last_progress_seconds": since,
+            "chain_digest": self.replica.committed_chain.hex(),
+            "state_digest": self.replica.state_digest.hex(),
+        }
+
     def metrics(self) -> dict:
         return {
             "replica": self.id,
@@ -1752,6 +1812,9 @@ class AsyncReplicaServer:
             "low_mark": self.replica.low_mark,
             "view": self.replica.view,
             "in_view_change": self.replica.in_view_change,
+            # Health document (ISSUE 16; shape contracted with
+            # core/net.cc metrics_json by HEALTH_DOC_VERSION).
+            **self._refresh_health(),
             **self.replica.counters,
         }
 

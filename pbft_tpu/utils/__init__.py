@@ -10,7 +10,14 @@ both runtimes by utils/trace_schema.py.
 """
 
 from .flight import FlightRecorder
-from .metrics import ConsensusSpans, MetricsRegistry, start_metrics_server
+from .metrics import (
+    ConsensusSpans,
+    MetricsRegistry,
+    count_open_fds,
+    file_size_bytes,
+    read_rss_bytes,
+    start_metrics_server,
+)
 from .trace import Tracer, get_tracer, set_trace_file
 
 __all__ = [
@@ -18,7 +25,10 @@ __all__ = [
     "FlightRecorder",
     "MetricsRegistry",
     "Tracer",
+    "count_open_fds",
+    "file_size_bytes",
     "get_tracer",
+    "read_rss_bytes",
     "set_trace_file",
     "start_metrics_server",
 ]

@@ -17,6 +17,7 @@ scripts/check_trace_schema.py enforces.
 from __future__ import annotations
 
 import bisect
+import os
 import threading
 import time
 from collections import OrderedDict
@@ -24,6 +25,46 @@ from typing import Callable, Dict, Optional, Tuple
 
 from . import trace_schema
 from .trace import Tracer
+
+
+# -- process resource readers (ISSUE 16 health document) ---------------------
+#
+# C++ mirror: read_rss_bytes/count_open_fds in core/net.cc. Both prefer
+# /proc/self (live resident set, not the ru_maxrss high-water mark) and
+# return 0 where /proc is absent — the detectors treat a zero reading as
+# "no data", never as a leak baseline.
+
+def read_rss_bytes() -> int:
+    """Current resident set in bytes (/proc/self/statm field 2 x page)."""
+    try:
+        with open("/proc/self/statm") as f:
+            rss_pages = int(f.read().split()[1])
+        return rss_pages * os.sysconf("SC_PAGE_SIZE")
+    except (OSError, IndexError, ValueError):
+        try:
+            import resource
+
+            return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+        except (ImportError, OSError):
+            return 0
+
+
+def count_open_fds() -> int:
+    """Open file descriptors for this process (/proc/self/fd entries)."""
+    try:
+        return len(os.listdir("/proc/self/fd"))
+    except OSError:
+        return 0
+
+
+def file_size_bytes(path: Optional[str]) -> int:
+    """On-disk size of ``path`` (0 when unset/absent) — the WAL gauge."""
+    if not path:
+        return 0
+    try:
+        return os.stat(path).st_size
+    except OSError:
+        return 0
 
 
 class Counter:
@@ -244,20 +285,33 @@ class ConsensusSpans:
 
 
 def start_metrics_server(
-    registry: MetricsRegistry, port: int, host: str = "127.0.0.1"
+    registry: MetricsRegistry, port: int, host: str = "127.0.0.1",
+    status_fn: Optional[Callable[[], dict]] = None,
 ):
     """Serve ``registry`` as Prometheus text on ``/metrics`` (any path,
     really — scrapers vary) from a daemon thread. Returns the HTTPServer;
     the bound port is ``server.server_address[1]`` (useful with port=0).
     Works for both runtimes' Python processes: the asyncio replica server
-    and the threaded verifier service — registry reads are GIL-atomic."""
+    and the threaded verifier service — registry reads are GIL-atomic.
+
+    With ``status_fn``, GET /status serves its dict as JSON — the health
+    document (ISSUE 16; C++ mirror: net.cc serve_metrics_ready routes
+    /status to metrics_json). status_fn runs on the scrape thread: it
+    must only read GIL-atomic runtime state, same contract as the
+    registry reads."""
+    import json as _json
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
     class Handler(BaseHTTPRequestHandler):
         def do_GET(self):  # noqa: N802 - http.server contract
-            body = registry.render_prometheus().encode()
+            if status_fn is not None and self.path.startswith("/status"):
+                body = _json.dumps(status_fn()).encode()
+                ctype = "application/json"
+            else:
+                body = registry.render_prometheus().encode()
+                ctype = "text/plain; version=0.0.4"
             self.send_response(200)
-            self.send_header("Content-Type", "text/plain; version=0.0.4")
+            self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)

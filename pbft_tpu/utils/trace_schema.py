@@ -267,6 +267,19 @@ METRIC_SCHEMAS = {
     "pbft_wal_fsyncs_total": ("counter", {"server.py", "net.cc"}),
     "pbft_wal_bytes_total": ("counter", {"server.py", "net.cc"}),
     "pbft_recovery_seconds": ("gauge", {"server.py", "net.cc"}),
+    # Health-introspection surface (ISSUE 16). Resource gauges a soak can
+    # gate flat: resident set (/proc/self/statm x page size), open file
+    # descriptors (/proc/self/fd entries), and the WAL file's on-disk
+    # byte size (0 with WAL off). Progress gauges a stall detector can
+    # watch: seconds since executed_upto last advanced (as observed at
+    # scrape/refresh time) and the verify-inbox depth. All five refresh
+    # lazily when the status/metrics surface is rendered — a dead-idle
+    # replica pays nothing for them.
+    "pbft_process_rss_bytes": ("gauge", {"server.py", "net.cc"}),
+    "pbft_open_fds": ("gauge", {"server.py", "net.cc"}),
+    "pbft_wal_disk_bytes": ("gauge", {"server.py", "net.cc"}),
+    "pbft_last_progress_seconds": ("gauge", {"server.py", "net.cc"}),
+    "pbft_inbox_depth": ("gauge", {"server.py", "net.cc"}),
     "pbft_batch_size": ("histogram", {"server.py", "net.cc"}),
     "pbft_verify_batch_size": ("histogram", {"server.py", "service.py", "net.cc"}),
     "pbft_verify_seconds": ("histogram", {"server.py", "service.py", "net.cc"}),
@@ -337,6 +350,19 @@ FLIGHT_EVENTS = {
     18: "recovery_complete",
 }
 FLIGHT_EVENT_IDS = {name: i for i, name in FLIGHT_EVENTS.items()}
+
+# -- health document (ISSUE 16) ----------------------------------------------
+#
+# Both runtimes extend their metrics_json/metrics() status surface into a
+# versioned health document: resource readings (rss_bytes, open_fds,
+# wal_disk_bytes), progress watermarks (inbox_depth, sealed_unexecuted,
+# waiting_requests, last_progress_seconds, uptime_seconds) and identity
+# digests (chain_digest, state_digest) alongside the existing counters.
+# health_version stamps the document shape so pbft_top and the detector
+# library (pbft_tpu/analysis/health.py) can refuse snapshots from a
+# runtime speaking a different schema. core/net.h mirrors the value
+# (kHealthDocVersion — constants lint pair "health document version").
+HEALTH_DOC_VERSION = 1
 
 # phase-transition -> the latency histogram it feeds (observed at
 # "executed" time from the span's stamps).

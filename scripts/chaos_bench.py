@@ -45,6 +45,7 @@ from pathlib import Path
 REPO = Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO))
 
+from pbft_tpu.analysis import health  # noqa: E402
 from pbft_tpu.consensus.messages import ClientRequest  # noqa: E402
 from pbft_tpu.net.gateway import GATEWAY_CLIENT_PREFIX  # noqa: E402
 from pbft_tpu.net.launcher import LocalCluster  # noqa: E402
@@ -503,10 +504,51 @@ class FaultSchedule(threading.Thread):
             self.result["killed_gateway_port"] = port
 
 
+class HealthSampler(threading.Thread):
+    """Polls every replica's /status health document into a
+    detector-ready history while the arm runs (ISSUE 16). Launch-faulted
+    replicas are excluded up front: a deliberately muted primary seals
+    work it can never execute and would false-trip the silent-stall
+    detector on an arm that is SUPPOSED to survive it. Dead replicas
+    simply stop answering — the detectors treat absence as no-data."""
+
+    def __init__(self, cluster, skip=(), interval_s=1.0):
+        super().__init__(daemon=True)
+        self.cluster = cluster
+        self.skip = set(skip)
+        self.interval_s = interval_s
+        self.history: list = []
+        self._stop_evt = threading.Event()
+
+    def run(self) -> None:
+        import urllib.request
+
+        t0 = time.monotonic()
+        while not self._stop_evt.wait(self.interval_s):
+            snap = {"t": time.monotonic() - t0, "replicas": {}}
+            for i, port in enumerate(self.cluster.metrics_ports):
+                if i in self.skip:
+                    continue
+                try:
+                    with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/status", timeout=1
+                    ) as resp:
+                        snap["replicas"][i] = json.loads(
+                            resp.read().decode()
+                        )
+                except (OSError, ValueError):
+                    pass
+            self.history.append(snap)
+
+    def stop(self) -> None:
+        self._stop_evt.set()
+
+
 def run_arm_traced(
     arm, n, clients, requests_each, window, batch, batch_flush_us, impl,
     gateways, vc_timeout_ms, admission_inflight, admission_backlog,
     fault_at_s, heal_at_s, deadline_s, seed, blackbox_dir, mode="sig",
+    health_gate=False,
 ) -> dict:
     import tempfile
 
@@ -557,10 +599,13 @@ def run_arm_traced(
             chaos_seed=seed if drop > 0 else None,
             trace_dir=str(trace_dir),
             flight_dir=str(flight_dir),
+            metrics_ports=health_gate,
         ) as cluster:
             cfg_path = Path(cluster.tmpdir.name) / "network.json"
             gws = []
             sched = None
+            sampler = None
+            health_verdicts: list = []
             try:
                 for gi in range(n_gw):
                     gws.append(
@@ -585,6 +630,10 @@ def run_arm_traced(
                         tentative_quorum=tentative_quorum,
                     )
                 )
+                if health_gate:
+                    sampler = HealthSampler(
+                        cluster, skip=set(faults or {}))
+                    sampler.start()
                 sched = FaultSchedule(cluster, arm, fault_at_s, heal_at_s, gws)
                 sched.start()
                 stats: dict = {}
@@ -612,7 +661,13 @@ def run_arm_traced(
                         "gateway_failovers",
                     )
                 }
+                if sampler is not None:
+                    sampler.stop()
+                    sampler.join(timeout=10)
+                    health_verdicts = health.run_detectors(sampler.history)
             finally:
+                if sampler is not None:
+                    sampler.stop()
                 for proc, _ in gws:
                     if proc.poll() is None:
                         proc.terminate()
@@ -680,6 +735,12 @@ def run_arm_traced(
         # black boxes into flight_dir (the tmpdir cleanup would race it,
         # so flight_dir lives in OUR aux dir, not the cluster's).
         ok = row["completed_pct"] >= COMPLETION_BAR[arm]
+        if health_gate:
+            row["health_verdicts"] = health_verdicts
+            row["health_snapshots"] = (
+                len(sampler.history) if sampler is not None else 0
+            )
+            ok = ok and not health_verdicts
         row["ok"] = ok
         if not ok and blackbox_dir:
             dest = Path(blackbox_dir) / f"{arm}-seed{seed}"
@@ -739,6 +800,12 @@ def main() -> int:
         help="comma-separated fast-path modes per arm (ISSUE 14): sig "
         "and/or mac (MAC-vector authenticators + tentative execution; "
         "the driver counts the 2f+1 tentative reply quorum)")
+    parser.add_argument(
+        "--health-gate", action="store_true",
+        help="ISSUE 16: sample every replica's /status health document "
+        "~1/s during the arm and fail it if the detector library "
+        "(silent stall, leak, divergence, stuck view change, queue "
+        "saturation) trips — verdicts land in the JSONL row")
     parser.add_argument("--out", default=None, help="append JSONL here")
     args = parser.parse_args()
 
@@ -753,6 +820,7 @@ def main() -> int:
                 args.vc_timeout_ms, args.admission_inflight,
                 args.admission_backlog, args.fault_at_s, args.heal_at_s,
                 args.deadline_s, args.seed, args.blackbox_dir, mode=mode,
+                health_gate=args.health_gate,
             )
             print(json.dumps(row), flush=True)
             rows.append(row)

@@ -36,6 +36,7 @@ from typing import Dict, List, Optional
 
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
+from pbft_tpu.analysis import health  # noqa: E402
 from pbft_tpu.consensus.faults import FaultSchedule, random_schedule  # noqa: E402
 from pbft_tpu.consensus.invariants import (  # noqa: E402
     InvariantChecker,
@@ -115,6 +116,7 @@ def run_one(
     flight_dir: Optional[str] = None,
     mode: str = "sig",
     crash_restart: bool = False,
+    health_gate: bool = False,
 ) -> dict:
     """One soak run. Returns {ok, seed, n, violation?, schedule, ...}.
 
@@ -155,6 +157,50 @@ def run_one(
     # request forever — a client bug, not a protocol liveness failure.
     pending: dict = {c: None for c in clients}
     last_progress = (0, 0)  # (step, max honest executed)
+
+    # --health-gate (ISSUE 16): synthetic health documents from the sim
+    # replicas each tick, judged by the SAME detector library the live
+    # gates use (pbft_tpu/analysis/health.py). The time axis is the tick
+    # index (the sim has no wall clock), so thresholds are in ticks:
+    # stall = three failed rescue windows — a replica that outlives three
+    # view-change rescues with pending work and flat executed_upto is
+    # wedged, not slow. Stall/stuck-view verdicts only consider
+    # RECOVERY-phase ticks (the schedule phase stalls legitimately under
+    # partitions and crashes); divergence is unconditional safety and
+    # watches every tick.
+    health_history: List[dict] = []
+
+    def health_snapshot(t: int) -> None:
+        honest = checker.honest()
+        outstanding = sum(1 for req in pending.values() if req is not None)
+        snap: dict = {"t": float(t), "replicas": {}}
+        for r in cluster.replicas:
+            if r.id not in honest or r.id in cluster.crashed:
+                continue
+            snap["replicas"][r.id] = {
+                "executed_upto": r.executed_upto,
+                "committed_upto": r.committed_upto,
+                "view": r.view,
+                "in_view_change": r.in_view_change,
+                "inbox_depth": r.pending_count(),
+                "sealed_unexecuted": max(0, r.seq_counter - r.executed_upto),
+                "waiting_requests": outstanding,
+                "chain_digest": r.committed_chain.hex(),
+            }
+        health_history.append(snap)
+
+    def health_verdicts() -> List[dict]:
+        if not health_gate:
+            return []
+        stall_ticks = 3 * STALL_WINDOW
+        recovery = [s for s in health_history if s["t"] > steps]
+        return (
+            health.detect_divergence(health_history)
+            + health.detect_silent_stall(recovery, stall_seconds=stall_ticks)
+            + health.detect_stuck_view_change(
+                recovery, stall_seconds=stall_ticks
+            )
+        )
 
     def live_target() -> int:
         primary = cluster.primary_id
@@ -207,6 +253,8 @@ def run_one(
                 "violation": str(v),
                 "schedule": schedule,
             }
+        if health_gate:
+            health_snapshot(t)
         if t % RETRANSMIT_EVERY == 5:
             retransmit()
         executed = max(
@@ -302,6 +350,24 @@ def run_one(
             "f+1 reply quorum (timestamps %s)"
             % (len(missing), len(submitted),
                [r.timestamp for r in missing[:8]]),
+            "health_verdicts": health_verdicts(),
+            "schedule": schedule,
+        })
+    verdicts = health_verdicts()
+    if verdicts:
+        # Completion-pct was green but a detector saw a silent stall /
+        # divergence window — exactly the failure class ISSUE 16 adds.
+        return with_black_box({
+            "ok": False,
+            "seed": seed,
+            "n": n,
+            "step": steps + recovery_steps,
+            "violation": "health: " + "; ".join(
+                "[%s] replica=%s %s"
+                % (v["detector"], v["replica"], v["reason"])
+                for v in verdicts
+            ),
+            "health_verdicts": verdicts,
             "schedule": schedule,
         })
     return {
@@ -312,6 +378,7 @@ def run_one(
         "executed": max(r.executed_upto for r in cluster.replicas),
         "faults_injected": cluster.faults_injected,
         "chaos_dropped": cluster.chaos_dropped,
+        "health_verdicts": [],
         "schedule": schedule,
     }
 
@@ -380,6 +447,12 @@ def main(argv=None) -> int:
         "write-ahead log and turn every crash recovery into a process "
         "RESTART that replays it — the S5 no-double-vote invariant runs "
         "alongside S1-S3/L1")
+    parser.add_argument(
+        "--health-gate", action="store_true",
+        help="cluster-health introspection (ISSUE 16): snapshot every "
+        "honest live replica's health document each tick and fail the "
+        "seed if the detector library finds a silent stall, divergence, "
+        "or stuck view change the invariant checker missed")
     parser.add_argument("--replay", type=int, default=None,
                         help="re-run ONE seed verbosely (deterministic)")
     parser.add_argument("--validate", action="store_true",
@@ -413,7 +486,8 @@ def main(argv=None) -> int:
                 res = run_one(args.replay, n, args.steps,
                               submit_every=args.submit_every, verbose=True,
                               flight_dir=args.flight_dir or None, mode=mode,
-                              crash_restart=args.crash_restart)
+                              crash_restart=args.crash_restart,
+                              health_gate=args.health_gate)
                 if res["ok"]:
                     print(f"  OK: {res['submitted']} requests, "
                           f"executed up to {res['executed']}, "
@@ -433,7 +507,8 @@ def main(argv=None) -> int:
                 res = run_one(seed, n, args.steps,
                               submit_every=args.submit_every,
                               flight_dir=args.flight_dir or None, mode=mode,
-                              crash_restart=args.crash_restart)
+                              crash_restart=args.crash_restart,
+                              health_gate=args.health_gate)
                 if res["ok"]:
                     print(f"seed {seed:>3} n={n} mode={mode}: OK  "
                           f"({res['submitted']} reqs, "

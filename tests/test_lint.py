@@ -330,6 +330,56 @@ def test_divergent_gateway_prefix_trips(tmp_path):
     assert any("gateway client-token prefix" in e for e in errors), errors
 
 
+def test_divergent_health_constants_trip(tmp_path):
+    """ISSUE 16 pairs: the health-document version, the silent-stall
+    threshold, and the snapshot cadence are operational contracts shared
+    by pbftd's /status route, the detector library, and the pbft_top /
+    endurance tooling — drift in any of them makes a gate judge one
+    runtime by the other's thresholds."""
+    root = _shadow_tree(tmp_path)
+    ts = root / "pbft_tpu" / "utils" / "trace_schema.py"
+    text = ts.read_text()
+    assert "HEALTH_DOC_VERSION = 1" in text
+    ts.write_text(text.replace(
+        "HEALTH_DOC_VERSION = 1", "HEALTH_DOC_VERSION = 2"))
+    errors = constants.check(root)
+    assert any("health document version" in e for e in errors), errors
+
+    root2 = _shadow_tree(tmp_path / "b")
+    hp = root2 / "pbft_tpu" / "analysis" / "health.py"
+    text = hp.read_text()
+    assert "HEALTH_STALL_SECONDS = 5" in text
+    hp.write_text(text.replace(
+        "HEALTH_STALL_SECONDS = 5", "HEALTH_STALL_SECONDS = 9"))
+    errors = constants.check(root2)
+    assert any("health stall threshold seconds" in e for e in errors), errors
+
+    root3 = _shadow_tree(tmp_path / "c")
+    hdr = root3 / "core" / "net.h"
+    text = hdr.read_text()
+    assert "kHealthSnapshotIntervalS = 2" in text
+    hdr.write_text(text.replace(
+        "kHealthSnapshotIntervalS = 2", "kHealthSnapshotIntervalS = 4"))
+    errors = constants.check(root3)
+    assert any(
+        "health snapshot interval seconds" in e for e in errors
+    ), errors
+
+
+def test_missing_health_gauge_in_cxx_table_trips(tmp_path):
+    """A health gauge dropped from metrics.cc's kGaugeNames (so pbftd
+    would stop exporting it) fails the manifest cross-check."""
+    root = _shadow_tree(tmp_path)
+    mc = root / "core" / "metrics.cc"
+    text = mc.read_text()
+    assert '"pbft_inbox_depth",' in text
+    mc.write_text(text.replace('    "pbft_inbox_depth",\n', '', 1))
+    errors = metrics_lint.check(root)
+    assert any(
+        "kGaugeNames" in e and "pbft_inbox_depth" in e for e in errors
+    ), errors
+
+
 def test_scanned_files_exist():
     """The shadow-tree contract: every scanned path exists in the repo
     (a rename must update the pass specs, not silently skip)."""
